@@ -1,0 +1,66 @@
+"""apex_tpu — a TPU-native training-performance toolbox.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability surface of NVIDIA
+Apex (reference: /root/reference).  Like the reference's top-level package
+(``apex/__init__.py:8-27``, which exports ``amp, fp16_utils, optimizers,
+normalization, transformer[, parallel]``), this package is a *toolbox* of
+independently usable components, not a monolithic trainer:
+
+- :mod:`apex_tpu.amp`            — precision policies (O0–O3 semantics, bf16-first)
+                                   and functional dynamic loss scaling.
+- :mod:`apex_tpu.optimizers`     — fused multi-tensor optimizers
+                                   (Adam/AdamW, LAMB, SGD, NovoGrad, Adagrad).
+- :mod:`apex_tpu.multi_tensor_apply` — scale / axpby / l2norm over pytrees.
+- :mod:`apex_tpu.normalization`  — fused LayerNorm / RMSNorm (Pallas + XLA).
+- :mod:`apex_tpu.fused_dense`, :mod:`apex_tpu.mlp` — fused GEMM+bias(+gelu).
+- :mod:`apex_tpu.parallel`       — data parallelism, SyncBatchNorm, LARC.
+- :mod:`apex_tpu.transformer`    — Megatron-style tensor / sequence / pipeline
+                                   parallelism over a `jax.sharding.Mesh`.
+- :mod:`apex_tpu.contrib`        — flash attention, fused cross-entropy,
+                                   group norm, sparsity, halo exchange, ZeRO
+                                   optimizers, and other specialized ops.
+
+Unlike the reference there are no build-time extension flags: every component
+is pure JAX (Pallas kernels JIT-compile on TPU; jnp fallbacks run anywhere).
+:mod:`apex_tpu.feature_registry` reports per-component availability the way
+the reference's per-extension import guards do.
+"""
+
+from apex_tpu._logging import _install_rank_aware_logging, set_logging_level
+
+__version__ = "0.1.0"
+
+# Mirrors the rank-aware root logging handler installed at import by the
+# reference (apex/__init__.py:31-43).
+_install_rank_aware_logging()
+
+# Lightweight submodule access without eager-importing the heavy stacks.
+import importlib as _importlib
+
+_SUBMODULES = (
+    "amp",
+    "fp16_utils",
+    "optimizers",
+    "multi_tensor_apply",
+    "normalization",
+    "fused_dense",
+    "mlp",
+    "parallel",
+    "transformer",
+    "contrib",
+    "ops",
+    "utils",
+    "feature_registry",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        module = _importlib.import_module(f"apex_tpu.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'apex_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

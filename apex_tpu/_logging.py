@@ -1,0 +1,69 @@
+"""Rank-aware logging for apex_tpu.
+
+The reference installs a root-logger handler whose formatter prefixes every
+record with distributed rank info (apex/__init__.py:31-43, pulling
+``parallel_state.get_rank_info``).  Here rank info comes from
+``jax.process_index`` plus (when initialized) the mesh registry in
+:mod:`apex_tpu.transformer.parallel_state`.
+"""
+
+from __future__ import annotations
+
+import logging
+
+
+class RankInfoFilter(logging.Filter):
+    """Injects a ``rank_info`` field into log records.
+
+    Cheap by design: reads process index lazily and tolerates JAX not being
+    initialized yet (import-time logging must never crash).
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.rank_info = _rank_info()
+        return True
+
+
+def _rank_info() -> str:
+    try:
+        import jax
+
+        parts = [f"p{jax.process_index()}"]
+    except Exception:
+        return "p?"
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            parts.append(parallel_state.get_rank_info())
+    except Exception:
+        pass
+    return "|".join(parts)
+
+
+_HANDLER: logging.Handler | None = None
+
+
+def _install_rank_aware_logging() -> None:
+    """Install one rank-aware handler on the ``apex_tpu`` logger (idempotent)."""
+    global _HANDLER
+    if _HANDLER is not None:
+        return
+    logger = logging.getLogger("apex_tpu")
+    handler = logging.StreamHandler()
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s [%(levelname)s|%(rank_info)s] %(name)s: %(message)s")
+    )
+    handler.addFilter(RankInfoFilter())
+    logger.addHandler(handler)
+    logger.propagate = False
+    _HANDLER = handler
+
+
+def set_logging_level(level: int | str) -> None:
+    """Set the apex_tpu logging level (reference: apex/transformer/log_util.py)."""
+    logging.getLogger("apex_tpu").setLevel(level)
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"apex_tpu.{name}")

@@ -1,0 +1,111 @@
+"""apex_tpu.amp — mixed precision with O0–O3 semantics (apex/amp parity).
+
+Functional re-design of ``amp.initialize`` (apex/amp/frontend.py:197),
+``amp.scale_loss`` (apex/amp/handle.py:16-160), and the dynamic
+``LossScaler`` (apex/amp/scaler.py).  No monkey-patching: the policy is data,
+the scaler is a pytree, and the train step stays jittable.
+
+Typical use::
+
+    from apex_tpu import amp
+
+    amped = amp.initialize(model.apply, params, opt_level="O2")
+    scaler, sstate = amped.scaler, amped.scaler_state
+
+    def train_step(params, sstate, batch):
+        def loss_fn(p):
+            out = amped.apply(p, batch["x"])
+            return compute_loss(out, batch["y"])
+        loss, grads = jax.value_and_grad(
+            lambda p: scaler.scale_loss(loss_fn(p), sstate))(params)
+        grads, found_inf = scaler.unscale(grads, sstate)
+        new_params, opt_state = opt.step(grads, params, opt_state,
+                                         found_inf=found_inf)
+        return new_params, scaler.update(sstate, found_inf)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import O0, O1, O2, O3, PrecisionPolicy, get_policy
+from apex_tpu.amp.scaler import LossScaler, LossScalerState, static_loss_scaler
+
+__all__ = [
+    "initialize",
+    "AmpState",
+    "PrecisionPolicy",
+    "get_policy",
+    "O0",
+    "O1",
+    "O2",
+    "O3",
+    "LossScaler",
+    "LossScalerState",
+    "static_loss_scaler",
+    "state_dict",
+    "load_state_dict",
+]
+
+
+@dataclasses.dataclass
+class AmpState:
+    """What ``amp.initialize`` hands back: policy-cast params, wrapped apply,
+    a configured scaler + its state (one per loss, ``num_losses`` parity with
+    apex/amp/_initialize.py)."""
+
+    apply: Callable
+    params: Any
+    policy: PrecisionPolicy
+    scaler: LossScaler
+    scaler_states: list[LossScalerState]
+
+    @property
+    def scaler_state(self) -> LossScalerState:
+        return self.scaler_states[0]
+
+
+def initialize(
+    apply_fn: Callable,
+    params: Any,
+    opt_level: str = "O1",
+    half_dtype=jnp.bfloat16,
+    num_losses: int = 1,
+    loss_scale: Optional[Any] = None,
+    **overrides,
+) -> AmpState:
+    """Configure mixed precision (apex/amp/frontend.py:197 parity).
+
+    Returns an :class:`AmpState`; unlike the reference nothing is patched —
+    use ``amped.apply``/``amped.params`` and thread scaler state explicitly.
+    """
+    if loss_scale is not None:
+        overrides["loss_scale"] = loss_scale
+    policy = get_policy(opt_level, half_dtype=half_dtype, **overrides)
+    scaler = policy.make_scaler()
+    return AmpState(
+        apply=policy.wrap_apply(apply_fn),
+        params=policy.cast_params(params),
+        policy=policy,
+        scaler=scaler,
+        scaler_states=[scaler.init() for _ in range(num_losses)],
+    )
+
+
+def state_dict(amp_state: AmpState) -> dict:
+    """Checkpoint all loss scalers (apex README "Checkpointing", amp.state_dict)."""
+    return {
+        f"loss_scaler{i}": amp_state.scaler.state_dict(s)
+        for i, s in enumerate(amp_state.scaler_states)
+    }
+
+
+def load_state_dict(amp_state: AmpState, d: dict) -> AmpState:
+    states = [
+        amp_state.scaler.load_state_dict(d[f"loss_scaler{i}"])
+        for i in range(len(amp_state.scaler_states))
+    ]
+    return dataclasses.replace(amp_state, scaler_states=states)

@@ -1,0 +1,146 @@
+"""Precision policies: the O0–O3 semantics of apex.amp, TPU-first.
+
+The reference's amp (apex/amp/frontend.py:9-193) defines four opt levels via
+``Properties``: cast_model_type, patch_torch_functions, keep_batchnorm_fp32,
+master_weights, loss_scale.  The O1 mechanism — monkey-patching the torch
+namespace from FP16/FP32/promote lists (apex/amp/lists/*.py, amp/amp.py:73-183)
+— has no JAX analog (SURVEY.md §7 "amp O1 function patching"); instead the
+policy is applied *explicitly*: cast params once, cast inputs at module
+boundaries, and keep normalization/losses in fp32.  This matches how JAX/Flax
+users express mixed precision and what XLA can optimize.
+
+On TPU the natural half dtype is bfloat16 (no loss scaling needed); fp16 is
+supported for parity, in which case a dynamic :class:`LossScaler` is the
+default, as in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, static_loss_scaler
+
+# Parameter-name fragments treated as "norm-like" and kept fp32 when
+# keep_norm_fp32 is set (the keep_batchnorm_fp32 semantics of O2,
+# apex/amp/frontend.py:118-143).
+_NORM_NAME_HINTS = ("norm", "bn", "batch_stats", "scale_param", "ln_")
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Dtype rules for one training setup (apex.amp ``Properties`` parity)."""
+
+    opt_level: str
+    param_dtype: Any  # dtype model params are stored in
+    compute_dtype: Any  # dtype matmuls/convs run in
+    output_dtype: Any  # dtype activations are returned in
+    keep_norm_fp32: bool  # keep_batchnorm_fp32 analog
+    master_weights: bool  # fp32 master copies in the optimizer
+    loss_scale: Any  # "dynamic" | float | None
+
+    # ---- casting helpers -------------------------------------------------
+    def cast_params(self, params: Any) -> Any:
+        """Cast params to param_dtype, keeping norm-like leaves fp32 if asked.
+
+        O2's ``model.to(cast_model_type)`` with BN exemption
+        (apex/amp/_initialize.py:176-239).
+        """
+        if self.param_dtype == jnp.float32:
+            return params
+
+        def cast(path, leaf):
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path).lower()
+            if self.keep_norm_fp32 and any(h in name for h in _NORM_NAME_HINTS):
+                return leaf
+            if jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf.astype(self.param_dtype)
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(cast, params)
+
+    def cast_inputs(self, *args):
+        """Cast floating-point array args to compute_dtype (the patched-forward
+        input cast of O2, apex/amp/_initialize.py:206-239)."""
+
+        def cast(x):
+            if isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.compute_dtype)
+            return x
+
+        out = jax.tree.map(cast, args)
+        return out[0] if len(args) == 1 else out
+
+    def cast_output(self, x):
+        def cast(leaf):
+            if isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jnp.floating):
+                return leaf.astype(self.output_dtype)
+            return leaf
+
+        return jax.tree.map(cast, x)
+
+    def wrap_apply(self, apply_fn):
+        """Wrap a model apply fn so inputs/outputs follow this policy."""
+
+        def wrapped(params, *args, **kwargs):
+            args = tuple(self.cast_inputs(a) for a in args)
+            return self.cast_output(apply_fn(params, *args, **kwargs))
+
+        return wrapped
+
+    def make_scaler(self) -> LossScaler:
+        if self.loss_scale == "dynamic":
+            return LossScaler()
+        if self.loss_scale is None:
+            return static_loss_scaler(1.0)
+        return static_loss_scaler(float(self.loss_scale))
+
+
+def O0() -> PrecisionPolicy:
+    """Pure fp32 (apex/amp/frontend.py O0)."""
+    return PrecisionPolicy("O0", jnp.float32, jnp.float32, jnp.float32, False, False, None)
+
+
+def O1(half_dtype=jnp.bfloat16) -> PrecisionPolicy:
+    """Per-op mixed precision: fp32 params, half compute at matmul-like ops.
+
+    The reference implements O1 by patching the torch namespace; here the
+    contract is: params stay fp32, modules cast to compute_dtype at GEMM
+    boundaries, reductions/norms/losses stay fp32.  apex_tpu layers honor
+    ``compute_dtype`` natively.
+    """
+    ls = "dynamic" if half_dtype == jnp.float16 else None
+    return PrecisionPolicy("O1", jnp.float32, half_dtype, jnp.float32, True, False, ls)
+
+
+def O2(half_dtype=jnp.bfloat16) -> PrecisionPolicy:
+    """"Almost FP16": half params/compute, fp32 norms, master weights,
+    dynamic loss scale (apex/amp/frontend.py O2)."""
+    ls = "dynamic" if half_dtype == jnp.float16 else None
+    return PrecisionPolicy("O2", half_dtype, half_dtype, half_dtype, True, True, ls)
+
+
+def O3(half_dtype=jnp.bfloat16) -> PrecisionPolicy:
+    """Pure half: speed baseline, no fp32 exemptions (apex/amp/frontend.py O3)."""
+    return PrecisionPolicy("O3", half_dtype, half_dtype, half_dtype, False, False, None)
+
+
+_LEVELS = {"O0": O0, "O1": O1, "O2": O2, "O3": O3}
+
+
+def get_policy(opt_level: str, half_dtype=jnp.bfloat16, **overrides) -> PrecisionPolicy:
+    """Build a policy by opt level with explicit overrides.
+
+    Override validation parity: apex rejects overrides that contradict the
+    level only when incoherent; here any field can be overridden via
+    dataclasses.replace semantics.
+    """
+    if opt_level not in _LEVELS:
+        raise ValueError(f"Unexpected optimization level {opt_level!r} (expected O0..O3)")
+    pol = _LEVELS[opt_level]() if opt_level == "O0" else _LEVELS[opt_level](half_dtype)
+    if overrides:
+        pol = dataclasses.replace(pol, **overrides)
+    return pol

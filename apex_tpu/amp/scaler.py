@@ -1,0 +1,122 @@
+"""Functional loss scaling.
+
+Re-design of the reference's dynamic loss scaler (apex/amp/scaler.py:33-217),
+the legacy ``fp16_utils`` scalers (apex/fp16_utils/loss_scaler.py:10-129) and
+the on-device hysteresis scale update (csrc/update_scale_hysteresis.cu:5-45).
+
+Under jit there is no "skip the step on overflow" control flow: the scaler
+state is a pytree threaded through the train step, ``found_inf`` is computed
+on-device, and the optimizer applies ``jnp.where(found_inf, old, new)`` — the
+same sync-free pattern as the reference's *capturable* FusedAdam
+(apex/optimizers/fused_adam.py:199-263).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import _nonfinite
+from apex_tpu.utils.tree_math import tree_scale
+
+
+class LossScalerState(NamedTuple):
+    """Device-resident scaler state (all scalars; jit-safe)."""
+
+    scale: jax.Array  # f32 current loss scale
+    growth_tracker: jax.Array  # i32 consecutive non-overflow steps
+    hysteresis_tracker: jax.Array  # i32 remaining overflows before backoff
+    unskipped: jax.Array  # i32 total applied steps (checkpoint parity: scaler.py "unskipped")
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaler:
+    """Static / dynamic / hysteresis loss scaling as a pure transform.
+
+    Defaults mirror the reference: init scale 2**16, x2 growth every 2000
+    clean steps, /2 backoff on overflow (apex/amp/scaler.py:33-64), optional
+    hysteresis>1 to tolerate several overflows before backing off
+    (csrc/update_scale_hysteresis.cu).  ``dynamic=False`` gives the static
+    scaler (``loss_scale=N`` in amp.initialize).
+    """
+
+    init_scale: float = 2.0**16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    hysteresis: int = 1
+    min_loss_scale: float = 1.0
+    max_loss_scale: float = 2.0**24
+    dynamic: bool = True
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.float32(self.init_scale),
+            growth_tracker=jnp.int32(0),
+            hysteresis_tracker=jnp.int32(self.hysteresis),
+            unskipped=jnp.int32(0),
+        )
+
+    def scale_loss(self, loss: jax.Array, state: LossScalerState) -> jax.Array:
+        """loss * scale in fp32 (apex/amp/handle.py:113)."""
+        return loss.astype(jnp.float32) * state.scale
+
+    def unscale(self, grads: Any, state: LossScalerState):
+        """Unscale grads and report overflow: (grads/scale, found_inf).
+
+        Parity: ``LossScaler.unscale_with_stashed``/``unscale``
+        (apex/amp/scaler.py:105-190) via multi_tensor_scale's overflow check.
+        """
+        inv = 1.0 / state.scale
+        found_inf = _nonfinite(grads)
+        return tree_scale(grads, inv), found_inf
+
+    def update(self, state: LossScalerState, found_inf: jax.Array) -> LossScalerState:
+        """Post-step scale update (branch-free; csrc/update_scale_hysteresis.cu:5-45)."""
+        if not self.dynamic:
+            return state._replace(
+                unskipped=state.unskipped + jnp.where(found_inf, 0, 1).astype(jnp.int32)
+            )
+        found_inf = found_inf.astype(jnp.bool_)
+
+        hys_after = jnp.where(found_inf, state.hysteresis_tracker - 1, state.hysteresis_tracker)
+        backoff = jnp.logical_and(found_inf, hys_after <= 0)
+        scale = jnp.where(
+            backoff,
+            jnp.maximum(state.scale * self.backoff_factor, self.min_loss_scale),
+            state.scale,
+        )
+        growth = jnp.where(found_inf, 0, state.growth_tracker + 1)
+        grow_now = growth >= self.growth_interval
+        scale = jnp.where(
+            grow_now, jnp.minimum(scale * self.growth_factor, self.max_loss_scale), scale
+        )
+        growth = jnp.where(grow_now, 0, growth).astype(jnp.int32)
+        hys_after = jnp.where(
+            jnp.logical_or(grow_now, backoff), jnp.int32(self.hysteresis), hys_after
+        ).astype(jnp.int32)
+        return LossScalerState(
+            scale=scale.astype(jnp.float32),
+            growth_tracker=growth,
+            hysteresis_tracker=hys_after,
+            unskipped=state.unskipped + jnp.where(found_inf, 0, 1).astype(jnp.int32),
+        )
+
+    # -- checkpoint parity (amp.state_dict / load_state_dict; README.md:66-104) --
+    def state_dict(self, state: LossScalerState) -> dict:
+        return {k: jax.device_get(v) for k, v in state._asdict().items()}
+
+    def load_state_dict(self, d: dict) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.float32(d["scale"]),
+            growth_tracker=jnp.int32(d["growth_tracker"]),
+            hysteresis_tracker=jnp.int32(d["hysteresis_tracker"]),
+            unskipped=jnp.int32(d["unskipped"]),
+        )
+
+
+def static_loss_scaler(loss_scale: float = 1.0) -> LossScaler:
+    return LossScaler(init_scale=loss_scale, dynamic=False)

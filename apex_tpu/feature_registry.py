@@ -1,0 +1,67 @@
+"""Feature availability registry.
+
+The reference gates each native extension behind a ``setup.py`` build flag
+(``--cuda_ext``, ``--xentropy``, ... — setup.py:139-860) and guards imports at
+use sites.  apex_tpu components are pure JAX and always importable; this
+registry records which *backends* a component can use on the current platform
+(Pallas TPU kernel vs. jnp/XLA fallback) so users and tests can introspect the
+same way the reference's import guards allowed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+
+@dataclasses.dataclass(frozen=True)
+class Feature:
+    name: str
+    description: str
+    pallas: bool  # has a hand-written Pallas TPU kernel path
+    fallback: str  # what runs when the Pallas path is unavailable
+
+
+_FEATURES: dict[str, Feature] = {}
+
+
+def register(name: str, description: str, pallas: bool, fallback: str = "jnp/XLA") -> None:
+    _FEATURES[name] = Feature(name, description, pallas, fallback)
+
+
+def available_features() -> dict[str, Feature]:
+    return dict(_FEATURES)
+
+
+@functools.cache
+def on_tpu() -> bool:
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def pallas_enabled() -> bool:
+    """Whether Pallas TPU kernels should be used (TPU backend present)."""
+    import os
+
+    if os.environ.get("APEX_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    return on_tpu()
+
+
+# Core features (mirrors SURVEY.md §2 component inventory).
+register("multi_tensor_apply", "packed multi-tensor scale/axpby/l2norm/opt updates", True)
+register("fused_optimizers", "FusedAdam/LAMB/SGD/NovoGrad/Adagrad", True)
+register("fused_layer_norm", "LayerNorm/RMSNorm fwd/bwd", True)
+register("fused_dense", "GEMM+bias(+gelu) epilogues", False, "XLA fusion")
+register("scaled_masked_softmax", "scaled (masked/causal) softmax", True)
+register("fused_rope", "rotary position embedding (sbhd/cached/thd/2d)", True)
+register("sync_batchnorm", "distributed Welford BN", False, "psum over mesh axis")
+register("flash_attention", "fused multihead attention (fmha parity)", True)
+register("xentropy", "fused softmax cross-entropy with label smoothing", True)
+register("group_norm", "NHWC group norm (+swish)", True)
+register("sparsity", "2:4 structured sparsity (ASP)", False)
+register("halo_exchange", "spatial-parallel halo exchange", False, "ppermute")

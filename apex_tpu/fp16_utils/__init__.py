@@ -1,0 +1,94 @@
+"""Legacy manual mixed-precision helpers (apex.fp16_utils parity).
+
+The reference keeps a pre-amp manual path: ``network_to_half``,
+``prep_param_lists``, ``master_params_to_model_params``
+(apex/fp16_utils/fp16util.py:22-178) and the ``FP16_Optimizer`` master-weight
+wrapper (apex/fp16_utils/fp16_optimizer.py:13-553).  The pytree analogs are
+small; :class:`FP16Optimizer` wraps any apex_tpu fused optimizer (or optax
+transform) with fp32 master params + loss scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState, static_loss_scaler
+from apex_tpu.utils.tree_math import tree_cast
+
+__all__ = [
+    "network_to_half",
+    "prep_param_lists",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "FP16Optimizer",
+]
+
+
+def network_to_half(params: Any, half_dtype=jnp.bfloat16) -> Any:
+    """Cast floating-point leaves to half (apex/fp16_utils/fp16util.py:22)."""
+    return jax.tree.map(
+        lambda x: x.astype(half_dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x, params
+    )
+
+
+def prep_param_lists(params: Any):
+    """(model_params_half, master_params_fp32) (fp16util.py:96-178)."""
+    return params, tree_cast(params, jnp.float32)
+
+
+def master_params_to_model_params(master: Any, like: Any) -> Any:
+    """Copy master fp32 → model dtype (fp16util.py:160)."""
+    return jax.tree.map(lambda m, p: m.astype(p.dtype), master, like)
+
+
+def model_grads_to_master_grads(grads: Any) -> Any:
+    return tree_cast(grads, jnp.float32)
+
+
+class FP16OptimizerState(NamedTuple):
+    master_params: Any
+    inner_state: Any
+    scaler_state: LossScalerState
+
+
+class FP16Optimizer:
+    """Master-weight wrapper (apex/fp16_utils/fp16_optimizer.py:13-553).
+
+    Wraps an object with ``init(params)``/``step(grads, params, state, ...)``
+    (any apex_tpu fused optimizer) so the inner update runs on fp32 masters
+    while the model keeps half params; grads are unscaled and overflow-guarded.
+    """
+
+    def __init__(self, inner, static_loss_scale: float | None = None, dynamic_loss_scale: bool = True):
+        self.inner = inner
+        self.scaler: LossScaler = (
+            LossScaler() if dynamic_loss_scale else static_loss_scaler(static_loss_scale or 1.0)
+        )
+
+    def init(self, params: Any) -> FP16OptimizerState:
+        master = tree_cast(params, jnp.float32)
+        return FP16OptimizerState(master, self.inner.init(master), self.scaler.init())
+
+    def scale_loss(self, loss, state: FP16OptimizerState):
+        return self.scaler.scale_loss(loss, state.scaler_state)
+
+    def step(self, grads: Any, params: Any, state: FP16OptimizerState):
+        grads32, found_inf = self.scaler.unscale(
+            tree_cast(grads, jnp.float32), state.scaler_state
+        )
+        new_master, new_inner = self.inner.step(
+            grads32, state.master_params, state.inner_state, found_inf=found_inf
+        )
+        new_params = master_params_to_model_params(new_master, params)
+        new_scaler = self.scaler.update(state.scaler_state, found_inf)
+        return new_params, FP16OptimizerState(new_master, new_inner, new_scaler)
+
+    def state_dict(self, state: FP16OptimizerState) -> dict:
+        """fp16_optimizer.py:212-273 parity (master params + scaler)."""
+        return {
+            "master_params": jax.device_get(state.master_params),
+            "scaler": self.scaler.state_dict(state.scaler_state),
+        }

@@ -1,0 +1,36 @@
+"""apex_tpu.optimizers — fused multi-tensor optimizers.
+
+Parity: ``apex.optimizers`` (apex/optimizers/__init__.py): FusedAdam,
+FusedLAMB, FusedSGD, FusedNovoGrad, FusedAdagrad, FusedMixedPrecisionLamb.
+All are capturable-by-construction (device step/scale/overflow; see
+apex/optimizers/fused_adam.py:199-263) and support fp32 master weights for
+half-precision params.  ``.as_optax()`` adapts any of them to an optax
+``GradientTransformation``.
+"""
+
+from apex_tpu.optimizers._common import FusedOptimizer
+from apex_tpu.optimizers.fused_adam import AdamState, FusedAdam
+from apex_tpu.optimizers.fused_adagrad import AdagradState, FusedAdagrad
+from apex_tpu.optimizers.fused_lamb import FusedLAMB, LambState
+from apex_tpu.optimizers.fused_mixed_precision_lamb import (
+    FusedMixedPrecisionLamb,
+    MixedPrecisionLambState,
+)
+from apex_tpu.optimizers.fused_novograd import FusedNovoGrad, NovoGradState
+from apex_tpu.optimizers.fused_sgd import FusedSGD, SGDState
+
+__all__ = [
+    "FusedOptimizer",
+    "FusedAdam",
+    "AdamState",
+    "FusedLAMB",
+    "LambState",
+    "FusedSGD",
+    "SGDState",
+    "FusedNovoGrad",
+    "NovoGradState",
+    "FusedAdagrad",
+    "AdagradState",
+    "FusedMixedPrecisionLamb",
+    "MixedPrecisionLambState",
+]

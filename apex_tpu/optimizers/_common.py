@@ -1,0 +1,130 @@
+"""Shared machinery for the fused optimizers.
+
+Design (SURVEY.md §7): the reference's multi-tensor CUDA kernels
+(csrc/multi_tensor_adam.cu etc., dispatched via
+apex/optimizers/fused_adam.py:109-117) collapse on TPU to one jitted pytree
+update — XLA fuses the per-leaf elementwise ops, and the *capturable*
+CUDA-graph-safe variant (apex/optimizers/fused_adam.py:199-263) is the
+default semantics here: step count, loss scale, and the overflow flag all
+live on device, and an overflow turns the whole update into a no-op via
+``jnp.where`` (sync-free step skipping).
+
+Every optimizer exposes:
+
+- ``init(params) -> state``
+- ``step(grads, params, state, *, grad_scale=None, found_inf=None)
+    -> (new_params, new_state)``
+- ``as_optax() -> optax.GradientTransformation`` for ecosystem interop.
+
+``master_weights=True`` keeps fp32 master copies when params are half
+(the fused_adam master-weight path, fused_adam.py:84-98): updates are
+computed on masters and params re-cast each step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+import optax
+
+
+def apply_if_finite(found_inf: Optional[jax.Array], new: Any, old: Any) -> Any:
+    """tree = found_inf ? old : new — the capturable skip (fused_adam.py:199-263)."""
+    if found_inf is None:
+        return new
+    return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
+
+
+def unscale_grads(grads: Any, grad_scale: Optional[jax.Array]) -> Any:
+    """grads / grad_scale in fp32 (the kernel-side inv_scale of capturable adam)."""
+    grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+    if grad_scale is None:
+        return grads
+    inv = 1.0 / jnp.asarray(grad_scale, jnp.float32)
+    return jax.tree.map(lambda g: g * inv, grads)
+
+
+def is_half(x) -> bool:
+    return x.dtype in (jnp.bfloat16, jnp.float16)
+
+
+class MasterState(NamedTuple):
+    master_params: Any  # fp32 copies (or None when unused)
+
+
+class FusedOptimizer:
+    """Base class: master-weight handling + optax adapter."""
+
+    def __init__(self, master_weights: bool = False):
+        self.master_weights = master_weights
+
+    # -- subclass interface ------------------------------------------------
+    def _init(self, params: Any) -> Any:
+        raise NotImplementedError
+
+    def _update(self, grads: Any, params: Any, inner_state: Any):
+        """Return (new_params, new_inner_state); grads are fp32, unscaled."""
+        raise NotImplementedError
+
+    # -- public API --------------------------------------------------------
+    def init(self, params: Any) -> Any:
+        inner = self._init(params)
+        if self.master_weights:
+            master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            return (inner, MasterState(master))
+        return (inner, MasterState(None))
+
+    def step(
+        self,
+        grads: Any,
+        params: Any,
+        state: Any,
+        *,
+        grad_scale: Optional[jax.Array] = None,
+        found_inf: Optional[jax.Array] = None,
+    ):
+        inner, masters = state
+        g32 = unscale_grads(grads, grad_scale)
+        work_params = masters.master_params if masters.master_params is not None else params
+        new_work, new_inner = self._update(g32, work_params, inner)
+        new_work = apply_if_finite(found_inf, new_work, work_params)
+        new_inner = apply_if_finite(found_inf, new_inner, inner)
+        if masters.master_params is not None:
+            new_params = jax.tree.map(lambda m, p: m.astype(p.dtype), new_work, params)
+            return new_params, (new_inner, MasterState(new_work))
+        return new_work, (new_inner, MasterState(None))
+
+    def as_optax(self) -> optax.GradientTransformation:
+        """Expose as an optax transform producing *updates* (param deltas)."""
+
+        def init_fn(params):
+            return self.init(params)
+
+        def update_fn(grads, state, params=None):
+            new_params, new_state = self.step(grads, params, state)
+            updates = jax.tree.map(lambda n, p: (n - p.astype(n.dtype)), new_params, params)
+            return updates, new_state
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+
+def bias_corrections(step: jax.Array, beta1: float, beta2: float):
+    t = step.astype(jnp.float32)
+    return 1.0 - beta1**t, 1.0 - beta2**t
+
+
+def tree_map_multi(fn: Callable, n_out: int, *trees: Any) -> tuple:
+    """Map ``fn`` (returning an ``n_out``-tuple) over trees; return n_out trees.
+
+    Unlike returning tuples from ``jax.tree.map`` this is safe when the
+    pytree itself contains tuples.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(trees[0])
+    rest = [treedef.flatten_up_to(t) for t in trees[1:]]
+    outs = [fn(*args) for args in zip(flat, *rest)]
+    return tuple(
+        jax.tree_util.tree_unflatten(treedef, [o[i] for o in outs]) for i in range(n_out)
+    )

@@ -1,0 +1,57 @@
+"""FusedAdagrad.
+
+Parity: ``apex.optimizers.FusedAdagrad`` (apex/optimizers/fused_adagrad.py)
+over ``multi_tensor_adagrad`` (csrc/multi_tensor_adagrad.cu): h += g^2;
+p -= lr * g / (sqrt(h) + eps); ``adagrad_w_mode`` gives decoupled weight decay.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import FusedOptimizer, tree_map_multi
+
+
+class AdagradState(NamedTuple):
+    step: jax.Array
+    sum_sq: Any  # "h"
+
+
+class FusedAdagrad(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-2,
+        eps: float = 1e-10,
+        weight_decay: float = 0.0,
+        adagrad_w_mode: bool = False,
+        master_weights: bool = False,
+    ):
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def _init(self, params: Any) -> AdagradState:
+        h = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return AdagradState(jnp.int32(0), h)
+
+    def _update(self, grads: Any, params: Any, state: AdagradState):
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+
+        def leaf(p, g, h):
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay and not self.adagrad_w_mode:
+                g = g + wd * p32
+            h = h + g * g
+            update = g / (jnp.sqrt(h) + self.eps)
+            if self.weight_decay and self.adagrad_w_mode:
+                update = update + wd * p32
+            return (p32 - lr * update).astype(p.dtype), h
+
+        new_p, new_h = tree_map_multi(leaf, 2, params, grads, state.sum_sq)
+        return new_p, AdagradState(state.step + 1, new_h)

@@ -1,0 +1,82 @@
+"""FusedAdam — Adam/AdamW with multi-tensor-fused semantics.
+
+Parity target: ``apex.optimizers.FusedAdam`` (apex/optimizers/fused_adam.py:68-305)
+and the ``multi_tensor_adam`` kernel (csrc/multi_tensor_adam.cu): fp32 state,
+load→fp32→update→store-in-param-dtype, adam_w_mode (decoupled wd) vs. L2 mode,
+bias correction, and the *capturable* on-device step/scale/overflow handling
+(fused_adam.py:199-263) — which is simply the default under jit.
+
+On TPU the whole update is one fused XLA loop over the pytree; a Pallas
+packed-buffer variant lives in :mod:`apex_tpu.ops.packed_update` for
+many-small-tensor models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import FusedOptimizer, bias_corrections, tree_map_multi
+
+
+class AdamState(NamedTuple):
+    step: jax.Array  # i32 on device (capturable parity)
+    exp_avg: Any  # m, fp32
+    exp_avg_sq: Any  # v, fp32
+
+
+class FusedAdam(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            # fused_adam.py:102 raises the same way
+            raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def _init(self, params: Any) -> AdamState:
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return AdamState(step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=jax.tree.map(jnp.copy, zeros))
+
+    def _update(self, grads: Any, params: Any, state: AdamState):
+        step = state.step + 1
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + wd * p32  # ADAM_MODE_0: L2 into the gradient
+            m = b1 * m + (1.0 - b1) * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if self.adam_w_mode and self.weight_decay:
+                update = update + wd * p32  # ADAM_MODE_1: decoupled wd
+            new_p = p32 - lr * update
+            return new_p.astype(p.dtype), m, v
+
+        new_p, new_m, new_v = tree_map_multi(
+            leaf, 3, params, grads, state.exp_avg, state.exp_avg_sq
+        )
+        return new_p, AdamState(step=step, exp_avg=new_m, exp_avg_sq=new_v)

@@ -1,0 +1,105 @@
+"""FusedLAMB — layer-wise adaptive large-batch optimizer.
+
+Parity: ``apex.optimizers.FusedLAMB`` (apex/optimizers/fused_lamb.py:63-213),
+which runs in two fused phases: (1) ``multi_tensor_l2norm`` computes
+per-tensor and global gradient norms; (2) ``multi_tensor_lamb``
+(csrc/multi_tensor_lamb.cu) applies Adam-style moments, *global* grad-norm
+clipping (divide by max(global_norm/max_grad_norm, 1)), then the per-tensor
+trust ratio ||p|| / ||update|| scaling the learning rate.
+
+``use_nvlamb=True`` applies the trust ratio even for tensors excluded from
+weight decay (the NVLAMB variant note in fused_lamb.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_l2norm
+from apex_tpu.optimizers._common import FusedOptimizer, bias_corrections, tree_map_multi
+
+
+class LambState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+class FusedLAMB(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        amsgrad: bool = False,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        master_weights: bool = False,
+    ):
+        if amsgrad:
+            raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _init(self, params: Any) -> LambState:
+        z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return LambState(jnp.int32(0), z, jax.tree.map(jnp.copy, z))
+
+    def _update(self, grads: Any, params: Any, state: LambState):
+        step = state.step + 1
+        # Phase 1 (fused_lamb.py:138-162): global grad norm + clip coefficient.
+        global_grad_norm = multi_tensor_l2norm(grads)
+        if self.max_grad_norm:
+            clip = jnp.maximum(global_grad_norm / self.max_grad_norm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g = g / clip
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + wd * p32  # LAMB "MODE 0": L2 into grad
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if self.adam_w_mode and self.weight_decay:
+                update = update + wd * p32
+            # trust ratio: ||p|| / ||update|| per tensor (multi_tensor_lamb.cu
+            # "lamb stage 2"); identity when either norm is 0, and — unless
+            # nvlamb — when the tensor has no weight decay.
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            ratio = jnp.where(
+                (p_norm > 0) & (u_norm > 0), p_norm / u_norm, jnp.float32(1.0)
+            )
+            if not (self.weight_decay or self.use_nvlamb):
+                ratio = jnp.float32(1.0)
+            new_p = p32 - lr * ratio * update
+            return new_p.astype(p.dtype), m, v
+
+        new_p, new_m, new_v = tree_map_multi(leaf, 3, params, grads, state.exp_avg, state.exp_avg_sq)
+        return new_p, LambState(step, new_m, new_v)

@@ -1,0 +1,101 @@
+"""FusedMixedPrecisionLamb — LAMB with on-device hyperparameter state.
+
+Parity: ``apex.optimizers.FusedMixedPrecisionLamb``
+(apex/optimizers/fused_mixed_precision_lamb.py): lr and step live as device
+tensors (CUDA-graph-capturable there; natural under jit here), gradient
+clipping by global norm happens *before* the LAMB stages, and model params
+may be half with fp32 masters held by the optimizer (master_weights defaults
+True — the ``reduced_precision_dtype`` path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor_apply import multi_tensor_l2norm
+from apex_tpu.optimizers._common import FusedOptimizer, bias_corrections, tree_map_multi
+
+
+class MixedPrecisionLambState(NamedTuple):
+    step: jax.Array
+    lr: jax.Array  # device-resident lr (tensor-lr parity)
+    exp_avg: Any
+    exp_avg_sq: Any
+
+
+class FusedMixedPrecisionLamb(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        step: int = 0,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        master_weights: bool = True,
+    ):
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self._init_step = step
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _init(self, params: Any) -> MixedPrecisionLambState:
+        z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return MixedPrecisionLambState(
+            step=jnp.int32(self._init_step),
+            lr=jnp.float32(self.lr),
+            exp_avg=z,
+            exp_avg_sq=jax.tree.map(jnp.copy, z),
+        )
+
+    def set_lr(self, state, lr):
+        """Update the device-resident lr inside the full (inner, master) state."""
+        inner, masters = state
+        return (inner._replace(lr=jnp.asarray(lr, jnp.float32)), masters)
+
+    def _update(self, grads: Any, params: Any, state: MixedPrecisionLambState):
+        step = state.step + 1
+        # Grad clipping by global norm happens BEFORE the lamb stages
+        # (fused_mixed_precision_lamb.py step()).
+        gnorm = multi_tensor_l2norm(grads)
+        clip = jnp.maximum(gnorm / self.max_grad_norm, 1.0) if self.max_grad_norm else jnp.float32(1.0)
+
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
+        lr = state.lr
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g = g / clip
+            m = b1 * m + beta3 * g
+            v = b2 * v + (1.0 - b2) * g * g
+            update = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if self.weight_decay:
+                update = update + wd * p32  # decoupled (adam_w) mode only
+            p_norm = jnp.sqrt(jnp.sum(p32 * p32))
+            u_norm = jnp.sqrt(jnp.sum(update * update))
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm, jnp.float32(1.0))
+            if not (self.weight_decay or self.use_nvlamb):
+                ratio = jnp.float32(1.0)
+            new_p = p32 - lr * ratio * update
+            return new_p.astype(p.dtype), m, v
+
+        new_p, new_m, new_v = tree_map_multi(leaf, 3, params, grads, state.exp_avg, state.exp_avg_sq)
+        return new_p, MixedPrecisionLambState(step, state.lr, new_m, new_v)

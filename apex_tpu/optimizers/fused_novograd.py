@@ -1,0 +1,87 @@
+"""FusedNovoGrad — NovoGrad with layer-wise (per-tensor scalar) second moment.
+
+Parity: ``apex.optimizers.FusedNovoGrad`` (apex/optimizers/fused_novograd.py)
+over ``multi_tensor_novograd`` (csrc/multi_tensor_novograd.cu): the second
+moment is one scalar per tensor (||g||^2 EMA); supports L2 vs decoupled wd,
+grad averaging, norm init with first-step grad norm (init_zero=False default).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import FusedOptimizer, bias_corrections, tree_map_multi
+
+
+class NovoGradState(NamedTuple):
+    step: jax.Array
+    exp_avg: Any  # per-element fp32 m
+    exp_avg_sq: Any  # per-tensor scalar v
+
+
+class FusedNovoGrad(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.95, 0.98),
+        eps: float = 1e-8,
+        weight_decay: float = 0.0,
+        grad_averaging: bool = False,
+        reg_inside_moment: bool = False,
+        norm_type: int = 2,
+        init_zero: bool = False,
+        master_weights: bool = False,
+    ):
+        if norm_type != 2:
+            raise RuntimeError("FusedNovoGrad only supports the L2 norm.")
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.grad_averaging = grad_averaging
+        self.reg_inside_moment = reg_inside_moment
+        self.init_zero = init_zero
+
+    def _init(self, params: Any) -> NovoGradState:
+        m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        v = jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params)
+        return NovoGradState(jnp.int32(0), m, v)
+
+    def _update(self, grads: Any, params: Any, state: NovoGradState):
+        step = state.step + 1
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+        first = (step == 1)
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g_sq = jnp.sum(g * g)
+            # first step: v initialized to ||g||^2 (init_zero=False path)
+            v_upd = b2 * v + (1.0 - b2) * g_sq
+            v_init = jnp.zeros((), jnp.float32) if self.init_zero else g_sq
+            v_new = jnp.where(first, v_init, v_upd)
+            denom = jnp.sqrt(v_new / bc2) + eps
+            g_hat = g / denom
+            if self.weight_decay and self.reg_inside_moment:
+                g_hat = g_hat + wd * p32
+            m_new = b1 * m + beta3 * g_hat
+            update = m_new / bc1
+            if self.weight_decay and not self.reg_inside_moment:
+                update = update + wd * p32
+            new_p = p32 - lr * update
+            return new_p.astype(p.dtype), m_new, v_new
+
+        new_p, new_m, new_v = tree_map_multi(leaf, 3, params, grads, state.exp_avg, state.exp_avg_sq)
+        return new_p, NovoGradState(step, new_m, new_v)

@@ -1,0 +1,76 @@
+"""FusedSGD — momentum SGD with multi-tensor-fused semantics.
+
+Parity: ``apex.optimizers.FusedSGD`` (apex/optimizers/fused_sgd.py) over the
+``multi_tensor_sgd`` kernel (csrc/multi_tensor_sgd_kernel.cu:280): momentum,
+dampening, nesterov, weight decay (optionally applied *after* momentum), and
+first-step momentum initialization identical to torch.optim.SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import FusedOptimizer, tree_map_multi
+
+
+class SGDState(NamedTuple):
+    step: jax.Array
+    momentum_buffer: Any  # fp32 (None-like zeros when momentum == 0)
+
+
+class FusedSGD(FusedOptimizer):
+    def __init__(
+        self,
+        lr: float,
+        momentum: float = 0.0,
+        dampening: float = 0.0,
+        weight_decay: float = 0.0,
+        nesterov: bool = False,
+        wd_after_momentum: bool = False,
+        materialize_master_grads: bool = True,  # accepted for API parity
+        master_weights: bool = False,
+    ):
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError("Nesterov momentum requires a momentum and zero dampening")
+        super().__init__(master_weights=master_weights)
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def _init(self, params: Any) -> SGDState:
+        buf = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        return SGDState(step=jnp.int32(0), momentum_buffer=buf)
+
+    def _update(self, grads: Any, params: Any, state: SGDState):
+        step = state.step + 1
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        mu, damp = self.momentum, self.dampening
+        # torch/apex semantics: on the first step the buffer is initialized to
+        # the (wd-adjusted) gradient, not damped (multi_tensor_sgd "first_run").
+        first = (step == 1)
+
+        def leaf(p, g, buf):
+            p32 = p.astype(jnp.float32)
+            if self.weight_decay and not self.wd_after_momentum:
+                g = g + wd * p32
+            if mu:
+                init_buf = g
+                upd_buf = mu * buf + (1.0 - damp) * g
+                buf = jnp.where(first, init_buf, upd_buf)
+                d_p = g + mu * buf if self.nesterov else buf
+            else:
+                d_p = g
+            if self.weight_decay and self.wd_after_momentum:
+                d_p = d_p + wd * p32
+            new_p = p32 - lr * d_p
+            return new_p.astype(p.dtype), buf
+
+        new_p, new_buf = tree_map_multi(leaf, 2, params, grads, state.momentum_buffer)
+        return new_p, SGDState(step=step, momentum_buffer=new_buf)

@@ -1,0 +1,31 @@
+"""Shared utilities: pytree flatten/packing, dtype helpers, tree math."""
+
+from apex_tpu.utils.packing import (
+    flatten_dense_tensors,
+    unflatten_dense_tensors,
+    PackedBuffer,
+    pack_pytree,
+    unpack_pytree,
+)
+from apex_tpu.utils.tree_math import (
+    tree_add,
+    tree_scale,
+    tree_axpby,
+    tree_l2norm,
+    tree_cast,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "flatten_dense_tensors",
+    "unflatten_dense_tensors",
+    "PackedBuffer",
+    "pack_pytree",
+    "unpack_pytree",
+    "tree_add",
+    "tree_scale",
+    "tree_axpby",
+    "tree_l2norm",
+    "tree_cast",
+    "tree_zeros_like",
+]

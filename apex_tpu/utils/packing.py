@@ -1,0 +1,106 @@
+"""Flatten / pack a list (or pytree) of arrays into one contiguous buffer.
+
+TPU-native equivalent of the reference's ``apex_C`` extension
+(csrc/flatten_unflatten.cpp:16-17, wrapping
+``torch::utils::flatten_dense_tensors``) used for DDP gradient bucketing
+(apex/parallel/distributed.py:15-36), and of the contiguous grad/param
+buffers in the ZeRO optimizer (apex/contrib/optimizers/distributed_fused_adam.py).
+
+On TPU a single flat buffer is also the shape strategy for the Pallas
+multi-tensor kernels (SURVEY.md §7 "Multi-tensor apply in Pallas"): instead of
+packing 110 tensor pointers per CUDA launch, we concatenate once (XLA keeps
+this cheap and fusable) and run one kernel over the padded flat buffer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_dense_tensors(tensors: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate arrays into one 1-D buffer (apex_C.flatten parity)."""
+    return jnp.concatenate([jnp.ravel(t) for t in tensors])
+
+
+def unflatten_dense_tensors(flat: jax.Array, like: Sequence[jax.Array]) -> list[jax.Array]:
+    """Split a flat buffer back into arrays shaped like ``like`` (apex_C.unflatten)."""
+    sizes = [int(np.prod(t.shape)) if t.ndim else 1 for t in like]
+    offsets = np.cumsum([0] + sizes)
+    return [
+        jax.lax.dynamic_slice(flat, (int(offsets[i]),), (sizes[i],)).reshape(like[i].shape)
+        for i in range(len(like))
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedSpec:
+    """Static description of a packed pytree: treedef + per-leaf shape/dtype/offset."""
+
+    treedef: Any
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[Any, ...]
+    offsets: tuple[int, ...]  # start offset of each leaf in the flat buffer
+    total: int  # unpadded element count
+    padded_total: int  # element count after padding to `pad_to`
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.shapes)
+
+
+@dataclasses.dataclass
+class PackedBuffer:
+    """A pytree flattened into one 1-D buffer plus its static spec.
+
+    The packed form is what the Pallas multi-tensor kernels operate on; the
+    ``spec`` lets us restore the original pytree exactly.
+    """
+
+    flat: jax.Array
+    spec: PackedSpec
+
+    def unpack(self) -> Any:
+        return unpack_pytree(self.flat, self.spec)
+
+
+def make_packed_spec(tree: Any, pad_to: int = 1024) -> PackedSpec:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+    offsets = tuple(int(o) for o in np.cumsum([0] + sizes)[:-1])
+    total = int(sum(sizes))
+    padded_total = ((total + pad_to - 1) // pad_to) * pad_to if total else pad_to
+    return PackedSpec(treedef, shapes, dtypes, offsets, total, padded_total)
+
+
+def pack_pytree(tree: Any, dtype=None, pad_to: int = 1024) -> PackedBuffer:
+    """Flatten a pytree of arrays into one padded 1-D buffer.
+
+    ``pad_to`` keeps the buffer length a multiple of the TPU lane*sublane tile
+    (8*128=1024 for f32) so Pallas kernels see aligned shapes.
+    """
+    spec = make_packed_spec(tree, pad_to=pad_to)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return PackedBuffer(jnp.zeros((spec.padded_total,), dtype or jnp.float32), spec)
+    cat_dtype = dtype or jnp.result_type(*[l.dtype for l in leaves])
+    flat = jnp.concatenate([jnp.ravel(l).astype(cat_dtype) for l in leaves])
+    pad = spec.padded_total - spec.total
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), cat_dtype)])
+    return PackedBuffer(flat, spec)
+
+
+def unpack_pytree(flat: jax.Array, spec: PackedSpec) -> Any:
+    leaves = []
+    for shape, dtype, offset in zip(spec.shapes, spec.dtypes, spec.offsets):
+        size = int(np.prod(shape)) if len(shape) else 1
+        leaf = jax.lax.dynamic_slice(flat, (offset,), (size,))
+        leaves.append(leaf.reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)

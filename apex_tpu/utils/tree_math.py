@@ -1,0 +1,54 @@
+"""Elementwise and reduction math over pytrees.
+
+These are the jnp building blocks behind :mod:`apex_tpu.multi_tensor_apply`;
+under ``jit`` XLA fuses the per-leaf ops, which is the TPU analog of the
+reference's single-launch multi-tensor CUDA kernels
+(csrc/multi_tensor_apply.cuh:16-133).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_add(a: Any, b: Any) -> Any:
+    return jax.tree.map(jnp.add, a, b)
+
+
+def tree_scale(tree: Any, scale) -> Any:
+    return jax.tree.map(lambda x: x * jnp.asarray(scale, x.dtype), tree)
+
+
+def tree_axpby(a, x: Any, b, y: Any) -> Any:
+    """out = a*x + b*y per leaf (amp_C.multi_tensor_axpby parity)."""
+    return jax.tree.map(
+        lambda xi, yi: jnp.asarray(a, xi.dtype) * xi + jnp.asarray(b, xi.dtype) * yi, x, y
+    )
+
+
+def tree_l2norm(tree: Any, per_leaf: bool = False):
+    """Global (and optionally per-leaf) L2 norm, accumulated in fp32.
+
+    Mirrors ``amp_C.multi_tensor_l2norm`` (csrc/multi_tensor_l2norm_kernel.cu)
+    which returns the global norm and, with ``per_tensor=True``, per-tensor norms.
+    """
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        zero = jnp.zeros((), jnp.float32)
+        return (zero, []) if per_leaf else zero
+    sq = [jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves]
+    total = jnp.sqrt(sum(sq))
+    if per_leaf:
+        return total, [jnp.sqrt(s) for s in sq]
+    return total
+
+
+def tree_cast(tree: Any, dtype) -> Any:
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def tree_zeros_like(tree: Any, dtype=None) -> Any:
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)

@@ -1,0 +1,41 @@
+"""Test harness: single-host multi-device mesh on CPU.
+
+TPU translation of the reference's ``MultiProcessTestCase``-style single-host
+multi-rank testing (apex/transformer/testing/distributed_test_base.py:22-82):
+instead of spawning processes, we force 8 virtual CPU devices and build real
+``jax.sharding.Mesh``es over them (SURVEY.md §4 "TPU translation").
+
+This file must run before jax initializes its backends, hence env mutation at
+import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 forced CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def mesh8(devices):
+    """A 1-D 8-device mesh named ('dp',)."""
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices[:8]), ("dp",))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(1234)

@@ -1,0 +1,104 @@
+"""amp policy + initialize behavior tests.
+
+Mirrors tests/L0/run_amp/test_basic_casts.py / test_checkpointing.py style:
+policy semantics per opt level, input/param casting, checkpoint roundtrip.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+
+
+def apply_fn(params, x):
+    return x @ params["w"] + params["b"]
+
+
+def make_params():
+    return {
+        "w": jnp.ones((4, 3), jnp.float32),
+        "b": jnp.zeros((3,), jnp.float32),
+        "norm_scale": jnp.ones((3,), jnp.float32),
+    }
+
+
+def test_o0_identity():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O0")
+    assert amped.params["w"].dtype == jnp.float32
+    out = amped.apply(amped.params, jnp.ones((2, 4), jnp.float32))
+    assert out.dtype == jnp.float32
+
+
+def test_o1_keeps_params_fp32():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O1")
+    assert amped.params["w"].dtype == jnp.float32
+    assert amped.policy.compute_dtype == jnp.bfloat16
+
+
+def test_o2_casts_params_keeps_norms():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O2")
+    assert amped.params["w"].dtype == jnp.bfloat16
+    # keep_batchnorm_fp32 analog: norm-like params stay fp32
+    assert amped.params["norm_scale"].dtype == jnp.float32
+    assert amped.policy.master_weights
+    out = amped.apply(amped.params, jnp.ones((2, 4), jnp.float32))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_o3_casts_everything():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O3")
+    assert amped.params["norm_scale"].dtype == jnp.bfloat16
+
+
+def test_fp16_gets_dynamic_scaler():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O2", half_dtype=jnp.float16)
+    assert amped.scaler.dynamic
+    assert float(amped.scaler_state.scale) == 2.0**16
+
+
+def test_bf16_gets_unit_static_scale():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O2")
+    assert not amped.scaler.dynamic
+    assert float(amped.scaler_state.scale) == 1.0
+
+
+def test_explicit_loss_scale_override():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O2", loss_scale=128.0)
+    assert float(amped.scaler_state.scale) == 128.0
+
+
+def test_bad_level_raises():
+    with pytest.raises(ValueError):
+        amp.initialize(apply_fn, make_params(), opt_level="O4")
+
+
+def test_state_dict_roundtrip():
+    amped = amp.initialize(apply_fn, make_params(), opt_level="O2",
+                           half_dtype=jnp.float16, num_losses=2)
+    d = amp.state_dict(amped)
+    assert set(d) == {"loss_scaler0", "loss_scaler1"}
+    amped2 = amp.load_state_dict(amped, d)
+    assert float(amped2.scaler_states[1].scale) == float(amped.scaler_states[1].scale)
+
+
+def test_end_to_end_bf16_training_step(rng):
+    """A minimal amp-style train step in bf16 (the README pattern)."""
+    from apex_tpu.optimizers import FusedSGD
+
+    params = make_params()
+    amped = amp.initialize(apply_fn, params, opt_level="O2")
+    opt = FusedSGD(lr=0.1, master_weights=True)
+    opt_state = opt.init(amped.params)
+    x = jnp.asarray(rng.standard_normal((8, 4)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((8, 3)), jnp.float32)
+
+    def loss_fn(p):
+        pred = amped.apply(p, x)
+        return jnp.mean((pred.astype(jnp.float32) - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(amped.params)
+    new_params, opt_state = opt.step(grads, amped.params, opt_state)
+    loss2 = loss_fn(new_params)
+    assert float(loss2) < float(loss)

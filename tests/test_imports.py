@@ -1,0 +1,29 @@
+"""Smoke: every public submodule imports (the reference's docker_extension_builds
+import-failure grep, tests/docker_extension_builds/run.sh, as a unit test)."""
+
+import importlib
+
+import pytest
+
+MODULES = [
+    "apex_tpu",
+    "apex_tpu.amp",
+    "apex_tpu.fp16_utils",
+    "apex_tpu.optimizers",
+    "apex_tpu.multi_tensor_apply",
+    "apex_tpu.utils",
+    "apex_tpu.feature_registry",
+]
+
+
+@pytest.mark.parametrize("mod", MODULES)
+def test_imports(mod):
+    importlib.import_module(mod)
+
+
+def test_feature_registry():
+    from apex_tpu import feature_registry
+
+    feats = feature_registry.available_features()
+    assert "fused_optimizers" in feats
+    assert "multi_tensor_apply" in feats

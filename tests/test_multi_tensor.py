@@ -1,0 +1,93 @@
+"""multi_tensor_apply + packing parity tests.
+
+Mirrors tests/L0/run_amp/test_multi_tensor_scale.py,
+test_multi_tensor_l2norm.py, test_multi_tensor_axpby.py in the reference.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor_apply import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+from apex_tpu.utils import (
+    flatten_dense_tensors,
+    pack_pytree,
+    unflatten_dense_tensors,
+)
+
+
+def _tree(rng, dtype=jnp.float32):
+    return {
+        "a": jnp.asarray(rng.standard_normal((37, 19)), dtype),
+        "b": [jnp.asarray(rng.standard_normal((5,)), dtype)],
+        "c": jnp.asarray(rng.standard_normal((128, 128)), dtype),
+    }
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_multi_tensor_scale(rng, dtype):
+    t = _tree(rng, dtype)
+    out, found_inf = jax.jit(lambda x: multi_tensor_scale(x, 4.0))(t)
+    ref = jax.tree.map(lambda x: x * jnp.asarray(4.0, dtype), t)
+    for o, r in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(np.asarray(o, np.float32), np.asarray(r, np.float32))
+    assert not bool(found_inf)
+
+
+def test_multi_tensor_scale_overflow(rng):
+    t = _tree(rng)
+    t["a"] = t["a"].at[0, 0].set(jnp.inf)
+    _, found_inf = multi_tensor_scale(t, 0.5)
+    assert bool(found_inf)
+    t["a"] = t["a"].at[0, 0].set(jnp.nan)
+    _, found_inf = multi_tensor_scale(t, 0.5)
+    assert bool(found_inf)
+
+
+def test_multi_tensor_axpby(rng):
+    x, y = _tree(rng), _tree(rng)
+    out, found_inf = multi_tensor_axpby(2.0, x, -3.0, y)
+    ref = jax.tree.map(lambda a, b: 2.0 * a - 3.0 * b, x, y)
+    for o, r in zip(jax.tree.leaves(out), jax.tree.leaves(ref)):
+        np.testing.assert_allclose(o, r, rtol=1e-6)
+    assert not bool(found_inf)
+
+
+def test_multi_tensor_l2norm(rng):
+    t = _tree(rng)
+    total = multi_tensor_l2norm(t)
+    flat = np.concatenate([np.ravel(l) for l in jax.tree.leaves(t)])
+    np.testing.assert_allclose(float(total), np.linalg.norm(flat), rtol=1e-6)
+
+    total2, per = multi_tensor_l2norm(t, per_tensor=True)
+    np.testing.assert_allclose(float(total2), float(total))
+    leaves = jax.tree.leaves(t)
+    assert len(per) == len(leaves)
+    for p, l in zip(per, leaves):
+        np.testing.assert_allclose(float(p), np.linalg.norm(np.ravel(l)), rtol=1e-6)
+
+
+def test_flatten_unflatten_roundtrip(rng):
+    tensors = [
+        jnp.asarray(rng.standard_normal((3, 4))),
+        jnp.asarray(rng.standard_normal((7,))),
+        jnp.asarray(rng.standard_normal((2, 2, 2))),
+    ]
+    flat = flatten_dense_tensors(tensors)
+    assert flat.shape == (3 * 4 + 7 + 8,)
+    back = unflatten_dense_tensors(flat, tensors)
+    for a, b in zip(tensors, back):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pack_pytree_roundtrip(rng):
+    t = _tree(rng)
+    packed = pack_pytree(t)
+    assert packed.flat.shape[0] % 1024 == 0
+    back = packed.unpack()
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6), t, back)

@@ -1,0 +1,105 @@
+"""LossScaler behavior tests.
+
+Mirrors the reference's scaler behavior (apex/amp/scaler.py:33-217) and the
+hysteresis kernel test (tests/L0/run_amp/test_update_scale_hysteresis.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.amp.scaler import LossScaler, static_loss_scaler
+
+
+def test_defaults_match_reference():
+    s = LossScaler()
+    st = s.init()
+    assert float(st.scale) == 2.0**16
+    assert s.growth_interval == 2000
+    assert s.backoff_factor == 0.5
+
+
+def test_scale_and_unscale():
+    s = LossScaler(init_scale=8.0)
+    st = s.init()
+    loss = jnp.float32(2.0)
+    assert float(s.scale_loss(loss, st)) == 16.0
+    grads = {"w": jnp.full((4,), 8.0)}
+    unscaled, found_inf = s.unscale(grads, st)
+    np.testing.assert_allclose(unscaled["w"], 1.0)
+    assert not bool(found_inf)
+
+
+def test_backoff_on_overflow():
+    s = LossScaler(init_scale=2.0**10)
+    st = s.init()
+    grads = {"w": jnp.asarray([jnp.inf, 1.0])}
+    _, found_inf = s.unscale(grads, st)
+    assert bool(found_inf)
+    st2 = s.update(st, found_inf)
+    assert float(st2.scale) == 2.0**9
+    assert int(st2.growth_tracker) == 0
+    assert int(st2.unskipped) == 0
+
+
+def test_growth_after_interval():
+    s = LossScaler(init_scale=4.0, growth_interval=3)
+    st = s.init()
+    ok = jnp.zeros((), jnp.bool_)
+    for _ in range(2):
+        st = s.update(st, ok)
+        assert float(st.scale) == 4.0
+    st = s.update(st, ok)
+    assert float(st.scale) == 8.0
+    assert int(st.growth_tracker) == 0
+
+
+def test_hysteresis():
+    # With hysteresis=2 the first overflow must NOT back off, the second must
+    # (csrc/update_scale_hysteresis.cu semantics).
+    s = LossScaler(init_scale=16.0, hysteresis=2)
+    st = s.init()
+    bad = jnp.ones((), jnp.bool_)
+    st = s.update(st, bad)
+    assert float(st.scale) == 16.0
+    st = s.update(st, bad)
+    assert float(st.scale) == 8.0
+
+
+def test_min_max_clamp():
+    s = LossScaler(init_scale=2.0, min_loss_scale=1.0, growth_interval=1, max_loss_scale=4.0)
+    st = s.init()
+    bad = jnp.ones((), jnp.bool_)
+    st = s.update(st, bad)
+    st = s.update(st, bad)
+    assert float(st.scale) == 1.0  # clamped at min
+    ok = jnp.zeros((), jnp.bool_)
+    st = s.update(st, ok)
+    st = s.update(st, ok)
+    st = s.update(st, ok)
+    assert float(st.scale) == 4.0  # clamped at max
+
+
+def test_static_scaler_never_moves():
+    s = static_loss_scaler(128.0)
+    st = s.init()
+    st = s.update(st, jnp.ones((), jnp.bool_))
+    st = s.update(st, jnp.zeros((), jnp.bool_))
+    assert float(st.scale) == 128.0
+    assert int(st.unskipped) == 1
+
+
+def test_update_is_jittable():
+    s = LossScaler()
+    st = s.init()
+    st2 = jax.jit(s.update)(st, jnp.zeros((), jnp.bool_))
+    assert int(st2.growth_tracker) == 1
+
+
+def test_state_dict_roundtrip():
+    s = LossScaler()
+    st = s.update(s.init(), jnp.ones((), jnp.bool_))
+    d = s.state_dict(st)
+    st2 = s.load_state_dict(d)
+    assert float(st2.scale) == float(st.scale)
+    assert int(st2.hysteresis_tracker) == int(st.hysteresis_tracker)

@@ -87,6 +87,7 @@ class PrecisionPolicy:
 
         def wrapped(params, *args, **kwargs):
             args = tuple(self.cast_inputs(a) for a in args)
+            kwargs = {k: self.cast_inputs(v) for k, v in kwargs.items()}
             return self.cast_output(apply_fn(params, *args, **kwargs))
 
         return wrapped

@@ -72,7 +72,11 @@ class LossScaler:
         """
         inv = 1.0 / state.scale
         found_inf = _nonfinite(grads)
-        return tree_scale(grads, inv), found_inf
+        # Unscale in fp32: the reference unscales into fp32 master grads
+        # (scaler.py:105-118); dividing fp16 grads by 2^16 in fp16 would
+        # flush to subnormals and destroy the precision loss scaling buys.
+        unscaled = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        return unscaled, found_inf
 
     def update(self, state: LossScalerState, found_inf: jax.Array) -> LossScalerState:
         """Post-step scale update (branch-free; csrc/update_scale_hysteresis.cu:5-45)."""
@@ -82,7 +86,11 @@ class LossScaler:
             )
         found_inf = found_inf.astype(jnp.bool_)
 
-        hys_after = jnp.where(found_inf, state.hysteresis_tracker - 1, state.hysteresis_tracker)
+        # The CUDA kernel resets the tracker on EVERY clean step ("Reset the
+        # hysteresis tracker if no infs are found", update_scale_hysteresis.cu),
+        # so only *consecutive* overflows burn hysteresis.
+        hys_after = jnp.where(found_inf, state.hysteresis_tracker - 1,
+                              jnp.int32(self.hysteresis))
         backoff = jnp.logical_and(found_inf, hys_after <= 0)
         scale = jnp.where(
             backoff,

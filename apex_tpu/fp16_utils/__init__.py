@@ -87,8 +87,17 @@ class FP16Optimizer:
         return new_params, FP16OptimizerState(new_master, new_inner, new_scaler)
 
     def state_dict(self, state: FP16OptimizerState) -> dict:
-        """fp16_optimizer.py:212-273 parity (master params + scaler)."""
+        """fp16_optimizer.py:212-273 parity: master params, inner optimizer
+        state (moments/step), and the scaler — everything needed to resume
+        the exact optimization trajectory."""
         return {
             "master_params": jax.device_get(state.master_params),
+            "optimizer_state_dict": jax.device_get(state.inner_state),
             "scaler": self.scaler.state_dict(state.scaler_state),
         }
+
+    def load_state_dict(self, d: dict) -> FP16OptimizerState:
+        """Inverse of :meth:`state_dict` (fp16_optimizer.py load_state_dict)."""
+        master = jax.tree.map(jnp.asarray, d["master_params"])
+        inner = jax.tree.map(jnp.asarray, d["optimizer_state_dict"])
+        return FP16OptimizerState(master, inner, self.scaler.load_state_dict(d["scaler"]))

@@ -1,0 +1,74 @@
+"""apex_tpu.mlp — whole-MLP fused forward/backward.
+
+Parity target: ``apex.mlp.MLP`` (apex/mlp/mlp.py:11-87) over the ``mlp_cuda``
+extension (csrc/mlp_cuda.cu:436-571): N stacked Linear(+bias)+activation
+layers executed as one fused unit (cuBLAS GEMMs + custom bias/activation
+kernels).
+
+TPU design: expressing the whole stack inside one jitted call gives XLA the
+full chain to fuse (bias+activation become GEMM epilogues; backward
+reuses saved activations exactly like the CUDA implementation).  Supported
+activations match the reference: 'none', 'relu', 'sigmoid'.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.fused_dense import linear_bias
+
+__all__ = ["MLP", "mlp_forward"]
+
+_ACTIVATIONS = {
+    "none": lambda x: x,
+    "relu": nn.relu,
+    "sigmoid": nn.sigmoid,
+}
+
+
+def mlp_forward(x, kernels: Sequence, biases: Sequence, activation: str = "relu"):
+    """Run the full MLP chain functionally (mlp_cuda.forward parity)."""
+    try:
+        act = _ACTIVATIONS[activation]
+    except KeyError:
+        raise ValueError(  # mlp.py:30 raises TypeError for bad activation
+            f"activation must be one of {sorted(_ACTIVATIONS)}, got {activation!r}")
+    n = len(kernels)
+    for i, (k, b) in enumerate(zip(kernels, biases)):
+        x = linear_bias(x, k.astype(x.dtype), b)
+        if i != n - 1:
+            x = act(x)
+    return x
+
+
+class MLP(nn.Module):
+    """Fused MLP module (apex.mlp.MLP).
+
+    ``mlp_sizes`` lists layer widths including the input width, exactly like
+    the reference; the activation applies between layers (not after the last).
+    """
+
+    mlp_sizes: Sequence[int]
+    use_bias: bool = True
+    activation: str = "relu"
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+
+    @nn.compact
+    def __call__(self, x):
+        sizes = list(self.mlp_sizes)
+        if len(sizes) < 2:
+            raise ValueError("mlp_sizes must name at least input and output widths")
+        if x.shape[-1] != sizes[0]:
+            raise ValueError(f"input width {x.shape[-1]} != mlp_sizes[0] {sizes[0]}")
+        kernels, biases = [], []
+        for i, (d_in, d_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+            kernels.append(self.param(f"kernel_{i}", self.kernel_init,
+                                      (d_in, d_out), self.param_dtype))
+            biases.append(self.param(f"bias_{i}", nn.initializers.zeros,
+                                     (d_out,), self.param_dtype) if self.use_bias else None)
+        return mlp_forward(x, kernels, biases, self.activation)

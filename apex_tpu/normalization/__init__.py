@@ -1,0 +1,107 @@
+"""apex_tpu.normalization — Fused LayerNorm / RMSNorm.
+
+Parity target: ``apex.normalization`` (apex/normalization/fused_layer_norm.py:16-472)
+— ``FusedLayerNorm`` / ``FusedRMSNorm`` modules, the ``Mixed*`` Megatron-compat
+mixed-dtype subclasses, and the functional forms — backed here by the Pallas
+kernels in :mod:`apex_tpu.ops.layer_norm` with a jnp fallback (the reference
+falls back to ``torch.nn.functional.layer_norm`` off-GPU the same way).
+
+Modules are lightweight parameter-factories in the JAX style: ``init(key)``
+returns a params dict, ``apply(params, x)`` runs the op.  A flax.linen wrapper
+is provided for each for drop-in use in linen models.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.ops.layer_norm import (
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    fused_rms_norm,
+    fused_rms_norm_affine,
+)
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "fused_layer_norm",
+    "fused_layer_norm_affine",
+    "fused_rms_norm",
+    "fused_rms_norm_affine",
+]
+
+Shape = Union[int, Sequence[int]]
+
+
+def _canon(normalized_shape: Shape) -> Tuple[int, ...]:
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(int(s) for s in normalized_shape)
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm with fused Pallas kernels (apex.normalization.FusedLayerNorm).
+
+    ``memory_efficient=True`` saves the output instead of the input for
+    backward (fused_layer_norm.py ``memory_efficient`` flag).
+    """
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_layer_norm(x, shape, self.eps,
+                                    memory_efficient=self.memory_efficient)
+        weight = self.param("scale", nn.initializers.ones, shape, self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+        return fused_layer_norm_affine(x, weight, bias, shape, self.eps,
+                                       memory_efficient=self.memory_efficient)
+
+
+class FusedRMSNorm(nn.Module):
+    """RMSNorm with fused Pallas kernels (apex.normalization.FusedRMSNorm)."""
+
+    normalized_shape: Shape
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    memory_efficient: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _canon(self.normalized_shape)
+        if not self.elementwise_affine:
+            return fused_rms_norm(x, shape, self.eps,
+                                  memory_efficient=self.memory_efficient)
+        weight = self.param("scale", nn.initializers.ones, shape, self.param_dtype)
+        return fused_rms_norm_affine(x, weight, shape, self.eps,
+                                     memory_efficient=self.memory_efficient)
+
+
+class MixedFusedLayerNorm(FusedLayerNorm):
+    """Megatron-compat variant: params stay fp32 while activations are half.
+
+    The reference's ``MixedFusedLayerNorm`` (fused_layer_norm.py) exists
+    because its plain kernels required input dtype == weight dtype; the mixed
+    subclass lifts that.  Our kernels are mixed-dtype natively (internals are
+    fp32), so this subclass only pins ``param_dtype`` to fp32.
+    """
+
+    param_dtype: Any = jnp.float32
+
+
+class MixedFusedRMSNorm(FusedRMSNorm):
+    param_dtype: Any = jnp.float32

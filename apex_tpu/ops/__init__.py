@@ -1,0 +1,13 @@
+"""apex_tpu.ops — the Pallas/XLA kernel toolbox (the reference's ``csrc/``).
+
+Each module pairs a Pallas TPU kernel with a pure-jnp fallback behind a
+dispatcher (mirroring the reference's "is this extension importable / is the
+kernel available for these shapes" guards, e.g.
+apex/transformer/functional/fused_softmax.py:164-275).  Public, stable
+entry points live in the package-level modules (:mod:`apex_tpu.normalization`,
+:mod:`apex_tpu.fused_dense`, ...); :mod:`apex_tpu.ops` is the kernel layer.
+"""
+
+from apex_tpu.ops._dispatch import kernels_enabled, on_tpu, use_interpret
+
+__all__ = ["kernels_enabled", "on_tpu", "use_interpret"]

@@ -1,0 +1,51 @@
+"""Kernel-availability dispatch (the TPU analog of extension import guards).
+
+The reference gates every fused path twice: once on "was the extension built"
+(lazy ``import amp_C`` etc.) and once on shape/dtype predicates
+(``FusedScaleMaskSoftmax.is_kernel_available``,
+apex/transformer/functional/fused_softmax.py:164-275).  Here the analogs are:
+
+- :func:`on_tpu` — Pallas TPU kernels only lower on a TPU backend.
+- ``APEX_TPU_KERNELS`` env var — ``"0"`` disables Pallas everywhere
+  (pure-jnp fallbacks, still jitted/fused by XLA), ``"interpret"`` runs
+  Pallas kernels in interpreter mode so CPU tests exercise the kernel code
+  path itself.
+- per-op shape predicates live next to each kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+
+_ENV = "APEX_TPU_KERNELS"
+
+
+@functools.lru_cache(maxsize=None)
+def on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def use_interpret() -> bool:
+    """Run Pallas kernels in interpret mode (CPU testing of kernel code)."""
+    return os.environ.get(_ENV, "").lower() == "interpret"
+
+
+def kernels_enabled() -> bool:
+    """Whether Pallas kernels should be used at all."""
+    mode = os.environ.get(_ENV, "").lower()
+    if mode == "0":
+        return False
+    if mode == "interpret":
+        return True
+    return on_tpu()
+
+
+def lane_aligned(*dims: int, lane: int = 128) -> bool:
+    """TPU kernels want the trailing dim to be a multiple of the lane width."""
+    return all(d % lane == 0 for d in dims)

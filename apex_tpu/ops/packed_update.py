@@ -1,0 +1,142 @@
+"""Packed-buffer Pallas optimizer updates — the multi-tensor-apply kernel.
+
+Parity target: ``amp_C.multi_tensor_adam`` / ``multi_tensor_sgd`` / the
+``multi_tensor_apply<depth>`` chunking harness
+(csrc/multi_tensor_apply.cuh:16-133, csrc/multi_tensor_adam.cu,
+csrc/multi_tensor_sgd_kernel.cu).  On CUDA the harness packs up to 110 tensor
+pointers and 320 (block→tensor, chunk) pairs per launch so one kernel updates
+the whole parameter list.
+
+TPU shape strategy (SURVEY.md §7 "Multi-tensor apply in Pallas"): ragged
+pointer tables don't map to Pallas, so the model's parameters are packed once
+into flat aligned buffers (:mod:`apex_tpu.utils.packing`) and ONE grid kernel
+sweeps the flat buffer in VMEM-sized chunks.  This keeps many-small-tensor
+models (embedding tables, biases, norm scales) from paying per-tensor
+dispatch, the same problem the CUDA harness solves.
+
+The kernels here are the innermost update math only; the user-facing
+optimizers (:mod:`apex_tpu.optimizers`) use per-leaf fused XLA updates by
+default and switch to the packed path via ``packed=True``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu.ops._dispatch import kernels_enabled, use_interpret
+
+_CHUNK = 64 * 1024  # elements per grid step; 4 fp32 buffers/step ≈ 1 MiB VMEM
+
+
+def _adam_kernel(g_ref, p_ref, m_ref, v_ref, scalars_ref,
+                 p_out, m_out, v_out, *, adam_w_mode):
+    """One packed-Adam chunk.  scalars = [lr, beta1, beta2, eps, wd, bc1, bc2, noop].
+
+    Math matches AdamFunctor (csrc/multi_tensor_adam.cu): load→fp32→update→
+    store; ``noop`` (overflow flag, fp32 0/1) makes the step an identity,
+    which is the capturable skip-on-overflow path (fused_adam.py:199-263).
+    """
+    lr = scalars_ref[0]
+    beta1 = scalars_ref[1]
+    beta2 = scalars_ref[2]
+    eps = scalars_ref[3]
+    wd = scalars_ref[4]
+    bc1 = scalars_ref[5]
+    bc2 = scalars_ref[6]
+    noop = scalars_ref[7]
+
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    m = m_ref[:]
+    v = v_ref[:]
+
+    if adam_w_mode:
+        m_new = beta1 * m + (1.0 - beta1) * g
+        v_new = beta2 * v + (1.0 - beta2) * g * g
+        denom = jnp.sqrt(v_new / bc2) + eps
+        update = (m_new / bc1) / denom + wd * p
+        p_new = p - lr * update
+    else:
+        g = g + wd * p
+        m_new = beta1 * m + (1.0 - beta1) * g
+        v_new = beta2 * v + (1.0 - beta2) * g * g
+        denom = jnp.sqrt(v_new / bc2) + eps
+        p_new = p - lr * (m_new / bc1) / denom
+
+    keep = noop == 0.0
+    p_out[:] = jnp.where(keep, p_new, p).astype(p_out.dtype)
+    m_out[:] = jnp.where(keep, m_new, m)
+    v_out[:] = jnp.where(keep, v_new, v)
+
+
+def packed_adam_update(flat_grad, flat_param, flat_m, flat_v, *,
+                       lr, beta1, beta2, eps, weight_decay,
+                       bias_correction1, bias_correction2,
+                       noop_flag=None, adam_w_mode: bool = True):
+    """Run the packed Adam kernel over flat 1-D buffers of equal length.
+
+    Buffers must be padded to a multiple of 1024 elements
+    (``apex_tpu.utils.packing.pack_pytree`` guarantees this).  Returns
+    (new_param, new_m, new_v).
+    """
+    n = flat_param.shape[0]
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(bias_correction1, jnp.float32),
+        jnp.asarray(bias_correction2, jnp.float32),
+        jnp.asarray(0.0 if noop_flag is None else noop_flag, jnp.float32),
+    ])
+    if not kernels_enabled() or n % 1024:
+        # jnp fallback with identical math
+        return _jnp_adam(flat_grad, flat_param, flat_m, flat_v, scalars, adam_w_mode)
+    # View the 1024-aligned flat buffer as (rows, 128) so blocks satisfy the
+    # (8, 128) f32 tiling; each grid step sweeps one VMEM-sized row chunk.
+    rows = n // 128
+    chunk_rows = min(_CHUNK // 128, rows)
+    while rows % chunk_rows:
+        chunk_rows //= 2
+    as2d = lambda a: a.reshape(rows, 128)
+    grid = rows // chunk_rows
+    block = pl.BlockSpec((chunk_rows, 128), lambda i: (i, 0))
+    p_new, m_new, v_new = pl.pallas_call(
+        functools.partial(_adam_kernel, adam_w_mode=adam_w_mode),
+        grid=(grid,),
+        in_specs=[block, block, block, block,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[block, block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 128), flat_param.dtype),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(as2d(flat_grad), as2d(flat_param), as2d(flat_m), as2d(flat_v), scalars)
+    return p_new.reshape(n), m_new.reshape(n), v_new.reshape(n)
+
+
+def _jnp_adam(g, p, m, v, scalars, adam_w_mode):
+    lr, beta1, beta2, eps, wd, bc1, bc2, noop = [scalars[i] for i in range(8)]
+    g32 = g.astype(jnp.float32)
+    p32 = p.astype(jnp.float32)
+    if adam_w_mode:
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        p_new = p32 - lr * ((m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * p32)
+    else:
+        g32 = g32 + wd * p32
+        m_new = beta1 * m + (1 - beta1) * g32
+        v_new = beta2 * v + (1 - beta2) * g32 * g32
+        p_new = p32 - lr * (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    keep = noop == 0.0
+    return (jnp.where(keep, p_new, p32).astype(p.dtype),
+            jnp.where(keep, m_new, m),
+            jnp.where(keep, v_new, v))

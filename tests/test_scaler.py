@@ -103,3 +103,39 @@ def test_state_dict_roundtrip():
     st2 = s.load_state_dict(d)
     assert float(st2.scale) == float(st.scale)
     assert int(st2.hysteresis_tracker) == int(st.hysteresis_tracker)
+
+
+def test_hysteresis_resets_on_clean_steps():
+    """Isolated overflows must not ratchet the scale down: the CUDA kernel
+    resets the hysteresis tracker on every clean step
+    (csrc/update_scale_hysteresis.cu "Reset the hysteresis tracker")."""
+    import jax.numpy as jnp
+    from apex_tpu.amp.scaler import LossScaler
+
+    s = LossScaler(init_scale=2.0**16, hysteresis=2)
+    st = s.init()
+    inf, ok = jnp.bool_(True), jnp.bool_(False)
+    st = s.update(st, inf)          # burns 1 hysteresis, no backoff
+    assert float(st.scale) == 2.0**16
+    for _ in range(5):
+        st = s.update(st, ok)       # clean steps reset the tracker
+    st = s.update(st, inf)          # isolated overflow again: still no backoff
+    assert float(st.scale) == 2.0**16
+    st = s.update(st, inf)          # consecutive overflow: now back off
+    assert float(st.scale) == 2.0**15
+
+
+def test_unscale_returns_fp32():
+    """Unscaling must not happen in fp16 (subnormal flush)."""
+    import jax.numpy as jnp
+    from apex_tpu.amp.scaler import LossScaler
+
+    s = LossScaler(init_scale=2.0**16)
+    st = s.init()
+    grads = {"w": jnp.full((4,), 2e-3, jnp.float16)}
+    unscaled, found = s.unscale(grads, st)
+    assert unscaled["w"].dtype == jnp.float32
+    expect = float(jnp.float16(2e-3)) / 2.0**16  # fp16-rounded input, fp32 math
+    assert abs(float(unscaled["w"][0]) - expect) < 1e-12
+    assert float(unscaled["w"][0]) > 0.0  # would flush to 0 in fp16 math
+    assert not bool(found)

@@ -70,13 +70,13 @@ class LossScaler:
         Parity: ``LossScaler.unscale_with_stashed``/``unscale``
         (apex/amp/scaler.py:105-190) via multi_tensor_scale's overflow check.
         """
-        inv = 1.0 / state.scale
         found_inf = _nonfinite(grads)
-        # Unscale in fp32: the reference unscales into fp32 master grads
-        # (scaler.py:105-118); dividing fp16 grads by 2^16 in fp16 would
-        # flush to subnormals and destroy the precision loss scaling buys.
-        unscaled = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-        return unscaled, found_inf
+        # Unscale in fp32 (shared helper): the reference unscales into fp32
+        # master grads (scaler.py:105-118); dividing fp16 grads by 2^16 in
+        # fp16 would flush to subnormals.
+        from apex_tpu.optimizers._common import unscale_grads
+
+        return unscale_grads(grads, state.scale), found_inf
 
     def update(self, state: LossScalerState, found_inf: jax.Array) -> LossScalerState:
         """Post-step scale update (branch-free; csrc/update_scale_hysteresis.cu:5-45)."""
@@ -89,7 +89,8 @@ class LossScaler:
         # The CUDA kernel resets the tracker on EVERY clean step ("Reset the
         # hysteresis tracker if no infs are found", update_scale_hysteresis.cu),
         # so only *consecutive* overflows burn hysteresis.
-        hys_after = jnp.where(found_inf, state.hysteresis_tracker - 1,
+        hys_after = jnp.where(found_inf,
+                              jnp.maximum(state.hysteresis_tracker - 1, 0),
                               jnp.int32(self.hysteresis))
         backoff = jnp.logical_and(found_inf, hys_after <= 0)
         scale = jnp.where(
@@ -103,9 +104,10 @@ class LossScaler:
             grow_now, jnp.minimum(scale * self.growth_factor, self.max_loss_scale), scale
         )
         growth = jnp.where(grow_now, 0, growth).astype(jnp.int32)
-        hys_after = jnp.where(
-            jnp.logical_or(grow_now, backoff), jnp.int32(self.hysteresis), hys_after
-        ).astype(jnp.int32)
+        # No reset on backoff: the CUDA kernel only resets the tracker on
+        # clean steps, so a sustained overflow burst keeps backing off every
+        # step once hysteresis is burnt (update_scale_hysteresis.cu).
+        hys_after = hys_after.astype(jnp.int32)
         return LossScalerState(
             scale=scale.astype(jnp.float32),
             growth_tracker=growth,

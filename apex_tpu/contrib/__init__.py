@@ -1,0 +1,38 @@
+"""apex_tpu.contrib — the specialized-kernel zoo (apex.contrib parity).
+
+Each submodule mirrors one reference contrib extension (SURVEY.md §2.2/§2.3),
+re-designed TPU-first.  All are importable unconditionally (no build flags);
+modules whose reference counterpart has no TPU analog (nccl_allocator,
+gpu_direct_storage, peer_memory IPC pools) are documented stubs.
+"""
+
+import importlib as _importlib
+
+_SUBMODULES = (
+    "clip_grad",
+    "xentropy",
+    "focal_loss",
+    "group_norm",
+    "groupbn",
+    "index_mul_2d",
+    "multihead_attn",
+    "fmha",
+    "optimizers",
+    "sparsity",
+    "transducer",
+    "bottleneck",
+    "peer_memory",
+    "openfold_triton",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        module = _importlib.import_module(f"apex_tpu.contrib.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'apex_tpu.contrib' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

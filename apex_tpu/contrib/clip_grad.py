@@ -1,0 +1,55 @@
+"""Fused gradient clipping by global norm.
+
+Parity target: ``apex.contrib.clip_grad.clip_grad_norm_``
+(apex/contrib/clip_grad/clip_grad.py:16), a drop-in for
+``torch.nn.utils.clip_grad_norm_`` built on ``multi_tensor_l2norm`` +
+``multi_tensor_scale``.  Here the norm and the conditional rescale compile to
+one fused pass; the function is pure (returns clipped grads + total norm)
+instead of mutating ``.grad``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils.tree_math import tree_l2norm
+
+__all__ = ["clip_grad_norm_", "clip_grad_norm"]
+
+
+def clip_grad_norm(grads: Any, max_norm: float, norm_type: float = 2.0,
+                   error_if_nonfinite: bool = False):
+    """Returns (clipped_grads, total_norm).
+
+    ``norm_type=2`` uses the fused fp32 l2norm (amp_C.multi_tensor_l2norm
+    parity); other norm types fall back to a generic reduction, like the
+    reference does (clip_grad.py:49-57).  ``error_if_nonfinite`` cannot raise
+    under jit; a nonfinite norm leaves grads unclipped (coef clamps to 1) and
+    the caller can inspect the returned norm, so the overflow-step machinery
+    (:mod:`apex_tpu.amp`) stays in charge of skipping.
+    """
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return grads, jnp.zeros((), jnp.float32)
+    if norm_type == 2.0:
+        total = tree_l2norm(grads)
+    elif norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    else:
+        p = norm_type
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(l.astype(jnp.float32)), p)) for l in leaves),
+            1.0 / p)
+    coef = jnp.asarray(max_norm, jnp.float32) / (total + 1e-6)
+    coef = jnp.minimum(coef, 1.0)
+    coef = jnp.where(jnp.isfinite(coef), coef, 1.0)
+    clipped = jax.tree.map(lambda g: (g.astype(jnp.float32) * coef).astype(g.dtype), grads)
+    return clipped, total
+
+
+# underscore alias keeps the reference's (mutating) name importable
+clip_grad_norm_ = clip_grad_norm

@@ -34,9 +34,15 @@ def network_to_half(params: Any, half_dtype=jnp.bfloat16) -> Any:
     )
 
 
+def _master_copy(params: Any) -> Any:
+    """fp32 master copies that never alias the model params (astype is a
+    no-op for already-fp32 leaves, which would break buffer donation)."""
+    return jax.tree.map(lambda p: jnp.copy(p).astype(jnp.float32), params)
+
+
 def prep_param_lists(params: Any):
     """(model_params_half, master_params_fp32) (fp16util.py:96-178)."""
-    return params, tree_cast(params, jnp.float32)
+    return params, _master_copy(params)
 
 
 def master_params_to_model_params(master: Any, like: Any) -> Any:
@@ -69,7 +75,7 @@ class FP16Optimizer:
         )
 
     def init(self, params: Any) -> FP16OptimizerState:
-        master = tree_cast(params, jnp.float32)
+        master = _master_copy(params)
         return FP16OptimizerState(master, self.inner.init(master), self.scaler.init())
 
     def scale_loss(self, loss, state: FP16OptimizerState):

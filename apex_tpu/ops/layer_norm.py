@@ -256,8 +256,18 @@ def _pallas_backward(dy2d, xin2d, mean, rstd, weight, bias, rms_only, mem_eff):
     return dx, dw, db
 
 
-def _kernel_ok(h: int) -> bool:
-    return kernels_enabled() and lane_aligned(h)
+# VMEM budget for the kernel path: each grid step holds a few
+# (_BLOCK_ROWS, H) fp32 tiles (x/y/temps fwd; dy/xin/dx bwd), so H is capped
+# at 4096 (~2 MiB per tile); the full-array stats blocks are (rows/128, 128)
+# fp32, so the row count is capped to keep them small.  Larger shapes take
+# the jnp fallback, which XLA handles fine.
+_MAX_H = 4096
+_MAX_ROWS = 256 * 1024
+
+
+def _kernel_ok(n: int, h: int) -> bool:
+    return (kernels_enabled() and lane_aligned(h)
+            and h <= _MAX_H and n <= _MAX_ROWS)
 
 
 # ---------------------------------------------------------------------------
@@ -274,7 +284,7 @@ def _norm_fwd(x, weight, bias, eps, rms_only, memory_efficient):
     shape = x.shape
     h = shape[-1]
     x2d = x.reshape(-1, h)
-    if _kernel_ok(h):
+    if _kernel_ok(x2d.shape[0], h):
         y2d, mean, rstd = _pallas_forward(x2d, weight, bias, eps, rms_only)
     else:
         y2d, mean, rstd = _jnp_forward(x2d, weight, bias, eps, rms_only)
@@ -288,7 +298,7 @@ def _norm_bwd(eps, rms_only, memory_efficient, res, dy):
     shape = dy.shape
     h = shape[-1]
     dy2d = dy.reshape(-1, h)
-    if _kernel_ok(h):
+    if _kernel_ok(dy2d.shape[0], h):
         dx2d, dw, db = _pallas_backward(dy2d, saved, mean, rstd, weight, bias,
                                         rms_only, memory_efficient)
     else:

@@ -12,9 +12,10 @@ Parity targets (the four Megatron softmax extensions, SURVEY.md §2.1):
 The CUDA kernels exist to fuse scale→mask→softmax into one pass and to keep
 the sk-length row in registers (warp softmax).  The Pallas equivalents keep a
 (rows, sk) tile in VMEM, do the reduction in fp32, and generate the causal
-mask with iota instead of loading one.  Unlike the CUDA kernels there is no
-sk ≤ 2048 limit; the generic/jnp path covers every shape, so the dispatcher
-(:mod:`apex_tpu.transformer.functional`) only routes on alignment, not size.
+mask with iota instead of loading one.  The kernel path routes on alignment
+and a VMEM-budget cap (``_MAX_SK``); everything else — including the CUDA
+kernels' un-servable shapes (sk > 2048, non-pow2) — takes the jnp path, which
+XLA still fuses into one pass.
 
 Masked-out semantics match the reference: masked positions get -10000 before
 softmax (mask==True means "mask out"), and fully-masked rows produce zeros
@@ -141,11 +142,19 @@ def _pallas_backward(y, dy, scale):
     return dx.reshape(b, h, sq, sk)
 
 
+# Each grid step keeps (1, block_rows, sk) fp32 tiles for x/mask/y (fwd) or
+# y/dy/dx (bwd) in VMEM, so sk is capped at 4096 (~2 MiB per tile).  Longer
+# rows fall back to jnp — and genuinely long sequences belong to the flash
+# attention path (apex_tpu.contrib.fmha), not a materialized softmax.
+_MAX_SK = 4096
+
+
 def _kernel_ok(x) -> bool:
     if not kernels_enabled() or x.ndim != 4:
         return False
     sq, sk = x.shape[-2], x.shape[-1]
-    return lane_aligned(sk) and (sq % min(_BLOCK_ROWS, sq) == 0) and sq >= 8
+    return (lane_aligned(sk) and sk <= _MAX_SK
+            and (sq % min(_BLOCK_ROWS, sq) == 0) and sq >= 8)
 
 
 # ---------------------------------------------------------------------------

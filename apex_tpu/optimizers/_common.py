@@ -73,7 +73,10 @@ class FusedOptimizer:
     def init(self, params: Any) -> Any:
         inner = self._init(params)
         if self.master_weights:
-            master = jax.tree.map(lambda p: p.astype(jnp.float32), params)
+            # jnp.copy: astype(fp32) on an already-fp32 leaf would return the
+            # *same* array, aliasing masters to params (breaks buffer donation
+            # and the master/model distinction for norm params kept fp32).
+            master = jax.tree.map(lambda p: jnp.copy(p).astype(jnp.float32), params)
             return (inner, MasterState(master))
         return (inner, MasterState(None))
 

@@ -1,0 +1,62 @@
+"""LARC — Layer-wise Adaptive Rate Clipping optimizer wrapper.
+
+Parity target: ``apex.parallel.LARC`` (apex/parallel/LARC.py:5-99): wraps any
+optimizer; before the inner step, each parameter's gradient is scaled by an
+adaptive local LR
+
+    adaptive_lr = trust_coefficient * ||p|| / (||g|| + wd * ||p|| + eps)
+
+clipped to the global LR when ``clip=True`` (``min(adaptive_lr/lr, 1)``), or
+used as a pure multiplier when ``clip=False``.  Parameters with zero norm (or
+zero grad norm) pass through untouched, as in the reference (LARC.py:86-88).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LARC"]
+
+
+class LARC:
+    """Wraps an apex_tpu fused optimizer (init/step interface)."""
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8):
+        self.inner = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    # delegate attributes (the reference proxies __getstate__/param_groups etc.)
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def init(self, params: Any):
+        return self.inner.init(params)
+
+    def _adjust(self, grads: Any, params: Any) -> Any:
+        lr = jnp.asarray(getattr(self.inner, "lr", 1.0), jnp.float32)
+        wd = jnp.asarray(getattr(self.inner, "weight_decay", 0.0), jnp.float32)
+
+        def scale_leaf(g, p):
+            g32 = g.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+            pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+            gn = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            adaptive = self.trust_coefficient * pn / (gn + wd * pn + self.eps)
+            if self.clip:
+                mult = jnp.minimum(adaptive / lr, 1.0)
+            else:
+                mult = adaptive
+            ok = jnp.logical_and(pn != 0.0, gn != 0.0)
+            mult = jnp.where(ok, mult, 1.0)
+            return (g32 * mult).astype(g.dtype)
+
+        return jax.tree.map(scale_leaf, grads, params)
+
+    def step(self, grads: Any, params: Any, state: Any, **kw):
+        return self.inner.step(self._adjust(grads, params), params, state, **kw)

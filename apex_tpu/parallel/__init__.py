@@ -1,0 +1,31 @@
+"""apex_tpu.parallel — data-parallel machinery over a mesh axis.
+
+Parity target: ``apex.parallel`` (SURVEY.md §2.3): DistributedDataParallel,
+Reducer, SyncBatchNorm (+ convert_syncbn_model), LARC.  The reference's
+``multiproc`` launcher is superseded by ``jax.distributed.initialize`` —
+see :func:`apex_tpu.transformer.parallel_state.initialize_distributed`.
+"""
+
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    allreduce_grads,
+    broadcast_params,
+)
+from apex_tpu.parallel.LARC import LARC
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    sync_batch_stats,
+)
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "allreduce_grads",
+    "broadcast_params",
+    "LARC",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "sync_batch_stats",
+]

@@ -1,0 +1,154 @@
+"""Data-parallel gradient synchronization over a mesh axis.
+
+Parity target: ``apex.parallel.DistributedDataParallel``
+(apex/parallel/distributed.py:131): broadcast-at-init, per-param grad hooks,
+flatten→allreduce→unflatten bucketing on side streams, and the knobs
+``delay_allreduce``, ``allreduce_always_fp32``, ``gradient_predivide_factor``.
+
+TPU-native design (SURVEY.md §7): a ``dp`` mesh axis replaces the NCCL process
+group.  Under ``pjit`` with batch sharded over ``dp`` and replicated params,
+XLA *already* inserts bucketed, overlapped gradient all-reduces — the entire
+hook/bucket/stream machinery of the reference is the compiler's job here.
+What remains ours is the semantics: predivide (average vs sum), fp32
+allreduce for half grads, and deferred sync for gradient accumulation.  Those
+live in :func:`allreduce_grads` (for explicit ``shard_map``/``pmap`` code) and
+:class:`DistributedDataParallel` (a thin wrapper holding the options, the way
+the reference's module wrapper holds them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def allreduce_grads(
+    grads: Any,
+    axis_name: str = "dp",
+    *,
+    allreduce_always_fp32: bool = False,
+    gradient_predivide_factor: float = 1.0,
+    gradient_average: bool = True,
+) -> Any:
+    """Sum/average grads across ``axis_name`` (inside shard_map/pmap/vmap).
+
+    Mirrors ``allreduce_bucket`` (apex/parallel/distributed.py:429-494):
+    optionally cast half grads to fp32 for the reduction
+    (``allreduce_always_fp32``), pre-divide by ``gradient_predivide_factor``
+    before the sum and post-divide by ``world/predivide`` after (the
+    reference's predivide split), or plain average.
+    """
+
+    axis_size = jax.lax.psum(1, axis_name)
+
+    def reduce_leaf(g):
+        orig_dtype = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / jnp.asarray(gradient_predivide_factor, g.dtype)
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            # net effect is /world_size, split as /predivide before and
+            # /(world/predivide) after, exactly like distributed.py:438-449
+            g = g / (axis_size / jnp.asarray(gradient_predivide_factor, jnp.float32)).astype(g.dtype)
+        if allreduce_always_fp32:
+            g = g.astype(orig_dtype)
+        return g
+
+    return jax.tree.map(reduce_leaf, grads)
+
+
+def broadcast_params(params: Any, axis_name: str = "dp", root: int = 0) -> Any:
+    """Make every rank use root's params (DDP init broadcast, distributed.py:257).
+
+    Under pjit with replicated sharding this is a no-op by construction; under
+    shard_map it selects root's copy via an index-0 all-gather.
+    """
+    def bcast(p):
+        gathered = jax.lax.all_gather(p, axis_name)
+        return gathered[root]
+
+    return jax.tree.map(bcast, params)
+
+
+@dataclasses.dataclass
+class DistributedDataParallel:
+    """Options holder + helpers for data-parallel training over a mesh axis.
+
+    Usage (explicit shard_map style, closest to the reference's semantics)::
+
+        ddp = DistributedDataParallel(axis_name="dp", gradient_predivide_factor=2.0)
+        def step(params, batch):             # runs inside shard_map over 'dp'
+            grads = jax.grad(loss_fn)(params, batch)
+            grads = ddp.allreduce(grads)     # or defer with delay_allreduce
+            ...
+
+    Usage (pjit style — recommended): shard the batch over ``dp``, replicate
+    params, and let XLA insert the reduction; ``ddp.shard_batch``/
+    ``ddp.replicate`` build the shardings.
+    """
+
+    axis_name: str = "dp"
+    mesh: Optional[Mesh] = None
+    allreduce_always_fp32: bool = False
+    gradient_predivide_factor: float = 1.0
+    gradient_average: bool = True
+    delay_allreduce: bool = False
+
+    def allreduce(self, grads: Any) -> Any:
+        if self.delay_allreduce:
+            # the reference registers no hooks and reduces in one shot later
+            return grads
+        return self._reduce(grads)
+
+    def sync(self, grads: Any) -> Any:
+        """Force the reduction (used at the end of accumulation when
+        delay_allreduce=True, mirroring needs_refresh/allreduce_params)."""
+        return self._reduce(grads)
+
+    def _reduce(self, grads: Any) -> Any:
+        return allreduce_grads(
+            grads,
+            self.axis_name,
+            allreduce_always_fp32=self.allreduce_always_fp32,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            gradient_average=self.gradient_average,
+        )
+
+    # -- pjit-style sharding helpers ---------------------------------------
+    def shard_batch(self, batch: Any) -> Any:
+        """Device_put a host batch sharded along the dp axis (dim 0)."""
+        if self.mesh is None:
+            raise ValueError("mesh is required for pjit-style sharding helpers")
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+
+    def replicate(self, params: Any) -> Any:
+        """Device_put params fully replicated over the mesh (init broadcast)."""
+        if self.mesh is None:
+            raise ValueError("mesh is required for pjit-style sharding helpers")
+        sharding = NamedSharding(self.mesh, P())
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), params)
+
+
+class Reducer:
+    """Manual allreduce helper (apex.parallel.Reducer, distributed.py:91).
+
+    The reference's Reducer broadcasts params at construction and averages
+    them across ranks when ``reduce()`` is called; here ``reduce`` averages a
+    pytree across the axis (call inside shard_map/pmap).
+    """
+
+    def __init__(self, axis_name: str = "dp"):
+        self.axis_name = axis_name
+
+    def reduce(self, tree: Any) -> Any:
+        size = jax.lax.psum(1, self.axis_name)
+        return jax.tree.map(
+            lambda x: jax.lax.psum(x, self.axis_name) / jnp.asarray(size, x.dtype), tree
+        )

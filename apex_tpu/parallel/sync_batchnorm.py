@@ -1,0 +1,153 @@
+"""SyncBatchNorm — batch norm with statistics reduced across the dp axis.
+
+Parity target: ``apex.parallel.SyncBatchNorm``, both implementations — the
+pure-Python fallback (apex/parallel/sync_batchnorm.py) and the ``syncbn``
+kernel version (optimized_sync_batchnorm{,_kernel}.py over csrc/welford.cu):
+Welford local stats → all_gather/merge → normalize, with process-group
+support, channels-last, and the fused-ReLU variant.
+
+TPU design: the Welford merge across ranks collapses to ``psum`` of
+(sum, sum_sq, count) over the mesh axis — numerically equivalent to the
+two-pass merge for the full-batch variance the reference computes, and XLA
+fuses the normalize+affine (+relu) into one elementwise pass (the syncbn
+kernel's job).  Channels-last is the native TPU layout, so ``channel_axis``
+defaults to -1 (the reference's NHWC path).  Autodiff through ``psum``
+reproduces the reference's backward (local sums all_reduced, syncbn.cpp:102-103).
+
+When no ``axis_name`` is given (or outside shard_map/pmap) stats are local —
+matching plain BatchNorm, the reference's behavior in a 1-process group.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+__all__ = ["SyncBatchNorm", "sync_batch_stats", "convert_syncbn_model"]
+
+
+def sync_batch_stats(x: jax.Array, channel_axis: int = -1,
+                     axis_name: Optional[str] = None):
+    """(mean, var, count) of x over all non-channel dims and all ranks.
+
+    The kernel path's welford_mean_var + welford_parallel
+    (csrc/syncbn.cpp:99-100) — here one fused fp32 (sum, sum_sq, n) psum.
+    Variance is biased (1/N), matching batch-norm semantics.
+    """
+    x32 = x.astype(jnp.float32)
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
+    n_local = 1
+    for a in axes:
+        n_local *= x.shape[a]
+    s = jnp.sum(x32, axis=axes)
+    ss = jnp.sum(jnp.square(x32), axis=axes)
+    n = jnp.asarray(n_local, jnp.float32)
+    if axis_name is not None:
+        s, ss, n = jax.lax.psum((s, ss, n), axis_name)
+    mean = s / n
+    var = ss / n - jnp.square(mean)
+    return mean, var, n
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in synchronized BatchNorm (apex.parallel.SyncBatchNorm).
+
+    - ``axis_name``: mesh axis to reduce stats over (the reference's
+      ``process_group``); None = local stats.
+    - ``fuse_relu``: the syncbn kernels' fused ReLU epilogue
+      (csrc/syncbn.cpp batchnorm_forward + ReLU bwd fusion).
+    - running stats live in the ``batch_stats`` collection like flax's own
+      BatchNorm, so checkpointing works unchanged.
+    """
+
+    num_features: Optional[int] = None  # inferred from input when None
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = None
+    channel_axis: int = -1
+    fuse_relu: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool = False):
+        ca = self.channel_axis % x.ndim
+        features = self.num_features if self.num_features else x.shape[ca]
+        shape = tuple(features if i == ca else 1 for i in range(x.ndim))
+
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((features,), jnp.float32))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            # During init() the module runs outside any mapped axis context,
+            # so the cross-rank reduction must be skipped.
+            axis = None if self.is_initializing() else self.axis_name
+            mean, var, n = sync_batch_stats(x, ca, axis)
+            if self.track_running_stats and not self.is_initializing():
+                m = self.momentum
+                # unbiased variance goes into the running buffer
+                # (sync_batchnorm.py matches torch BN semantics here)
+                unbiased = var * n / jnp.maximum(n - 1.0, 1.0)
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
+            var.reshape(shape) + self.eps)
+        if self.affine:
+            weight = self.param("scale", nn.initializers.ones,
+                                (features,), self.param_dtype)
+            bias = self.param("bias", nn.initializers.zeros,
+                              (features,), self.param_dtype)
+            y = y * weight.reshape(shape) + bias.reshape(shape)
+        if self.fuse_relu:
+            y = jnp.maximum(y, 0.0)
+        return y.astype(x.dtype)
+
+
+def convert_syncbn_model(module: nn.Module, axis_name: str = "dp") -> nn.Module:
+    """Recursively swap ``flax.linen.BatchNorm`` for :class:`SyncBatchNorm`.
+
+    Parity: ``apex.parallel.convert_syncbn_model`` (apex/parallel/__init__.py:21).
+    Works for declaratively-defined submodules (dataclass fields and
+    lists/dicts thereof); modules instantiated inline inside ``@nn.compact``
+    bodies cannot be rewritten from outside — declare them as attributes, or
+    use :class:`SyncBatchNorm` directly.
+    """
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            eps=module.epsilon,
+            momentum=1.0 - module.momentum,
+            affine=module.use_scale and module.use_bias,
+            axis_name=axis_name,
+        )
+
+    def walk(v):
+        if isinstance(v, nn.Module):
+            return convert_syncbn_model(v, axis_name)
+        if isinstance(v, (list, tuple)):
+            t = type(v)
+            return t(walk(i) for i in v)
+        if isinstance(v, dict):
+            return {k: walk(i) for k, i in v.items()}
+        return v
+
+    changed = {}
+    for f in getattr(module, "__dataclass_fields__", {}):
+        if f in ("parent", "name"):
+            continue
+        v = getattr(module, f, None)
+        nv = walk(v)
+        if nv is not v:
+            changed[f] = nv
+    if changed:
+        return module.clone(**changed)
+    return module

@@ -1,0 +1,179 @@
+"""ImageNet training example — the reference's ``examples/imagenet/main_amp.py``
+re-designed TPU-first.
+
+Demonstrates the Phase-3 slice (SURVEY.md §7): ResNet-50 with
+
+- precision policy (O0–O3, bf16-first) from :mod:`apex_tpu.amp`,
+- :class:`apex_tpu.parallel.SyncBatchNorm` (stats over the dp axis),
+- :class:`apex_tpu.optimizers.FusedSGD` (momentum + weight decay),
+- data parallelism over a ``dp`` mesh axis (XLA inserts the grad allreduce,
+  replacing the reference's DDP bucket machinery),
+- optional dynamic loss scaling for fp16 parity.
+
+Runs on synthetic data by default (`--synthetic`), so it works anywhere:
+single TPU chip, TPU pod slice, or the 8-virtual-device CPU mesh used by the
+test-suite.  The reference's ``--prof`` NVTX window maps to
+``jax.profiler.trace``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import apex_tpu
+from apex_tpu.amp import get_policy
+from apex_tpu.optimizers import FusedSGD
+from apex_tpu.parallel import DistributedDataParallel, SyncBatchNorm
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: int = 1
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        bn = functools.partial(SyncBatchNorm, axis_name=self.axis_name)
+        residual = x
+        y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        y = bn(fuse_relu=True)(y, use_running_average=not train)
+        y = nn.Conv(self.features, (3, 3), (self.strides, self.strides),
+                    use_bias=False)(y)
+        y = bn(fuse_relu=True)(y, use_running_average=not train)
+        y = nn.Conv(self.features * 4, (1, 1), use_bias=False)(y)
+        y = bn()(y, use_running_average=not train)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features * 4, (1, 1),
+                               (self.strides, self.strides), use_bias=False)(x)
+            residual = bn()(residual, use_running_average=not train)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet-v1.5 (the torchvision resnet50 the reference example trains)."""
+
+    stage_sizes: tuple = (3, 4, 6, 3)
+    num_classes: int = 1000
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(64, (7, 7), (2, 2), use_bias=False)(x)
+        x = SyncBatchNorm(axis_name=self.axis_name, fuse_relu=True)(
+            x, use_running_average=not train)
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                x = BottleneckBlock(64 * 2 ** i,
+                                    strides=2 if i > 0 and j == 0 else 1,
+                                    axis_name=self.axis_name)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def resnet50(num_classes=1000, axis_name=None):
+    return ResNet(num_classes=num_classes, axis_name=axis_name)
+
+
+def resnet18_ish(num_classes=1000, axis_name=None):
+    return ResNet(stage_sizes=(1, 1, 1, 1), num_classes=num_classes,
+                  axis_name=axis_name)
+
+
+def cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    ap.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
+    ap.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
+    ap.add_argument("--batch-size", type=int, default=64, help="global batch")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--synthetic", action="store_true", default=True)
+    ap.add_argument("--prof", action="store_true",
+                    help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
+    args = ap.parse_args()
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("dp",))
+    print(f"devices: {len(devices)} × {devices[0].platform}")
+
+    half = jnp.bfloat16 if args.half == "bf16" else jnp.float16
+    policy = get_policy(args.opt_level, half_dtype=half)
+    model = (resnet50 if args.arch == "resnet50" else resnet18_ish)(
+        args.num_classes, axis_name=None)  # pjit-style: stats are global already
+    ddp = DistributedDataParallel(axis_name="dp", mesh=mesh)
+
+    rng = jax.random.PRNGKey(0)
+    x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
+    variables = model.init(rng, x0, train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = policy.cast_params(params)
+
+    opt = FusedSGD(lr=args.lr, momentum=0.9, weight_decay=1e-4,
+                   master_weights=policy.master_weights)
+    opt_state = opt.init(params)
+    scaler = policy.make_scaler()
+    scaler_state = scaler.init()
+
+    # replicate model state, shard batch over dp
+    params, opt_state, batch_stats = ddp.replicate((params, opt_state, batch_stats))
+    scaler_state = ddp.replicate(scaler_state)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def train_step(params, batch_stats, opt_state, scaler_state, images, labels):
+        def loss_fn(p):
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                policy.cast_inputs(images), train=True, mutable=["batch_stats"])
+            return scaler.scale_loss(cross_entropy(logits, labels), scaler_state), upd
+
+        grads, upd = jax.grad(loss_fn, has_aux=True)(params)
+        grads, found_inf = scaler.unscale(grads, scaler_state)
+        new_params, new_opt = opt.step(grads, params, opt_state, found_inf=found_inf)
+        new_scaler = scaler.update(scaler_state, found_inf)
+        return new_params, upd["batch_stats"], new_opt, new_scaler, found_inf
+
+    per_host = args.batch_size
+    key = np.random.default_rng(0)
+    images = jnp.asarray(key.standard_normal(
+        (per_host, args.image_size, args.image_size, 3)), jnp.float32)
+    labels = jnp.asarray(key.integers(0, args.num_classes, per_host), jnp.int32)
+    images, labels = ddp.shard_batch((images, labels))
+
+    with mesh:
+        t0 = None
+        for step in range(args.steps):
+            if args.prof and step == 5:
+                jax.profiler.start_trace("/tmp/apex_tpu_trace")
+            params, batch_stats, opt_state, scaler_state, found_inf = train_step(
+                params, batch_stats, opt_state, scaler_state, images, labels)
+            if args.prof and step == 10:
+                jax.profiler.stop_trace()
+            if step == 1:  # skip compile
+                jax.block_until_ready(params)
+                t0 = time.perf_counter()
+        jax.block_until_ready(params)
+        dt = time.perf_counter() - t0
+        imgs_per_sec = args.batch_size * (args.steps - 2) / dt
+    print(f"throughput: {imgs_per_sec:.1f} imgs/sec "
+          f"({imgs_per_sec / len(devices):.1f}/chip), overflow={bool(found_inf)}")
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -11,12 +11,18 @@ import time.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the ambient environment selects a TPU plugin
+# (JAX_PLATFORMS=axon): the suite's multi-rank tests need 8 virtual devices.
+# The axon sitecustomize imports jax at interpreter start, freezing the env's
+# JAX_PLATFORMS into jax.config — so update the config, not the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, static_loss_scaler
+from apex_tpu.optimizers._common import master_copy
 from apex_tpu.utils.tree_math import tree_cast
 
 __all__ = [
@@ -34,15 +35,9 @@ def network_to_half(params: Any, half_dtype=jnp.bfloat16) -> Any:
     )
 
 
-def _master_copy(params: Any) -> Any:
-    """fp32 master copies that never alias the model params (astype is a
-    no-op for already-fp32 leaves, which would break buffer donation)."""
-    return jax.tree.map(lambda p: jnp.copy(p).astype(jnp.float32), params)
-
-
 def prep_param_lists(params: Any):
     """(model_params_half, master_params_fp32) (fp16util.py:96-178)."""
-    return params, _master_copy(params)
+    return params, master_copy(params)
 
 
 def master_params_to_model_params(master: Any, like: Any) -> Any:
@@ -75,7 +70,7 @@ class FP16Optimizer:
         )
 
     def init(self, params: Any) -> FP16OptimizerState:
-        master = _master_copy(params)
+        master = master_copy(params)
         return FP16OptimizerState(master, self.inner.init(master), self.scaler.init())
 
     def scale_loss(self, loss, state: FP16OptimizerState):

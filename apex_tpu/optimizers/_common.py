@@ -38,6 +38,16 @@ def apply_if_finite(found_inf: Optional[jax.Array], new: Any, old: Any) -> Any:
     return jax.tree.map(lambda n, o: jnp.where(found_inf, o, n), new, old)
 
 
+def master_copy(params: Any) -> Any:
+    """fp32 master copies that never alias the model params.
+
+    ``astype(fp32)`` is a no-op returning the *same* array for fp32 leaves
+    (e.g. norm params kept fp32 by the precision policy), which would break
+    buffer donation and the master/model distinction — hence the copy.
+    """
+    return jax.tree.map(lambda p: jnp.copy(p).astype(jnp.float32), params)
+
+
 def unscale_grads(grads: Any, grad_scale: Optional[jax.Array]) -> Any:
     """grads / grad_scale in fp32 (the kernel-side inv_scale of capturable adam)."""
     grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
@@ -73,11 +83,7 @@ class FusedOptimizer:
     def init(self, params: Any) -> Any:
         inner = self._init(params)
         if self.master_weights:
-            # jnp.copy: astype(fp32) on an already-fp32 leaf would return the
-            # *same* array, aliasing masters to params (breaks buffer donation
-            # and the master/model distinction for norm params kept fp32).
-            master = jax.tree.map(lambda p: jnp.copy(p).astype(jnp.float32), params)
-            return (inner, MasterState(master))
+            return (inner, MasterState(master_copy(params)))
         return (inner, MasterState(None))
 
     def step(

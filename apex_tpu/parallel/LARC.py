@@ -54,9 +54,21 @@ class LARC:
                 mult = adaptive
             ok = jnp.logical_and(pn != 0.0, gn != 0.0)
             mult = jnp.where(ok, mult, 1.0)
+            # the reference folds weight decay into the gradient BEFORE the
+            # adaptive scaling and zeroes the group's wd (LARC.py:95-105), so
+            # decay is applied at the adaptive rate, not the full rate
+            g32 = g32 + wd * p32
             return (g32 * mult).astype(g.dtype)
 
         return jax.tree.map(scale_leaf, grads, params)
 
     def step(self, grads: Any, params: Any, state: Any, **kw):
-        return self.inner.step(self._adjust(grads, params), params, state, **kw)
+        adjusted = self._adjust(grads, params)
+        # inner wd was folded into the adjusted grads (reference zeroes
+        # group['weight_decay'] for the inner step)
+        saved_wd = getattr(self.inner, "weight_decay", 0.0)
+        try:
+            self.inner.weight_decay = 0.0
+            return self.inner.step(adjusted, params, state, **kw)
+        finally:
+            self.inner.weight_decay = saved_wd

@@ -70,8 +70,11 @@ def broadcast_params(params: Any, axis_name: str = "dp", root: int = 0) -> Any:
     shard_map it selects root's copy via an index-0 all-gather.
     """
     def bcast(p):
-        gathered = jax.lax.all_gather(p, axis_name)
-        return gathered[root]
+        # psum of the root-masked value: O(|p|) memory, unlike an all_gather
+        # (which would hold world_size copies just to index one out)
+        rank = jax.lax.axis_index(axis_name)
+        masked = jnp.where(rank == root, p, jnp.zeros_like(p))
+        return jax.lax.psum(masked, axis_name)
 
     return jax.tree.map(bcast, params)
 

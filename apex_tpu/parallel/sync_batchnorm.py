@@ -7,8 +7,8 @@ Welford local stats → all_gather/merge → normalize, with process-group
 support, channels-last, and the fused-ReLU variant.
 
 TPU design: the Welford merge across ranks collapses to ``psum`` of
-(sum, sum_sq, count) over the mesh axis — numerically equivalent to the
-two-pass merge for the full-batch variance the reference computes, and XLA
+locally-centered (count, sum, M2) statistics over the mesh axis — the same
+conditioning as the reference's Welford merge — and XLA
 fuses the normalize+affine (+relu) into one elementwise pass (the syncbn
 kernel's job).  Channels-last is the native TPU layout, so ``channel_axis``
 defaults to -1 (the reference's NHWC path).  Autodiff through ``psum``
@@ -35,21 +35,30 @@ def sync_batch_stats(x: jax.Array, channel_axis: int = -1,
     """(mean, var, count) of x over all non-channel dims and all ranks.
 
     The kernel path's welford_mean_var + welford_parallel
-    (csrc/syncbn.cpp:99-100) — here one fused fp32 (sum, sum_sq, n) psum.
-    Variance is biased (1/N), matching batch-norm semantics.
+    (csrc/syncbn.cpp:99-100): locally-centered (mean, M2) per shard, one psum
+    to merge.  Variance is biased (1/N), matching batch-norm semantics.
     """
     x32 = x.astype(jnp.float32)
     axes = tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
     n_local = 1
     for a in axes:
         n_local *= x.shape[a]
-    s = jnp.sum(x32, axis=axes)
-    ss = jnp.sum(jnp.square(x32), axis=axes)
-    n = jnp.asarray(n_local, jnp.float32)
+    # Welford-style merge: center locally first (mean_l, M2_l), then combine
+    # shards with one psum.  Raw E[x^2]-E[x]^2 cancels catastrophically for
+    # large-mean/small-variance channels (can go negative → NaN via rsqrt);
+    # the local centering keeps M2 well-conditioned like the reference's
+    # welford kernels, and the merge term only sees the variance *of the
+    # shard means*.  Clamp guards the remaining rounding.
+    mean_l = jnp.mean(x32, axis=axes)
+    m2_l = jnp.sum(jnp.square(x32 - jnp.expand_dims(mean_l, axes)), axis=axes)
+    n_l = jnp.asarray(n_local, jnp.float32)
     if axis_name is not None:
-        s, ss, n = jax.lax.psum((s, ss, n), axis_name)
-    mean = s / n
-    var = ss / n - jnp.square(mean)
+        n, s1, m2, s2 = jax.lax.psum(
+            (n_l, n_l * mean_l, m2_l, n_l * jnp.square(mean_l)), axis_name)
+    else:
+        n, s1, m2, s2 = n_l, n_l * mean_l, m2_l, n_l * jnp.square(mean_l)
+    mean = s1 / n
+    var = jnp.maximum((m2 + s2 - n * jnp.square(mean)) / n, 0.0)
     return mean, var, n
 
 
@@ -68,6 +77,8 @@ class SyncBatchNorm(nn.Module):
     eps: float = 1e-5
     momentum: float = 0.1
     affine: bool = True
+    use_scale: Optional[bool] = None  # default: affine
+    use_bias: Optional[bool] = None  # default: affine
     track_running_stats: bool = True
     axis_name: Optional[str] = None
     channel_axis: int = -1
@@ -102,12 +113,16 @@ class SyncBatchNorm(nn.Module):
 
         y = (x.astype(jnp.float32) - mean.reshape(shape)) * jax.lax.rsqrt(
             var.reshape(shape) + self.eps)
-        if self.affine:
+        use_scale = self.affine if self.use_scale is None else self.use_scale
+        use_bias = self.affine if self.use_bias is None else self.use_bias
+        if use_scale:
             weight = self.param("scale", nn.initializers.ones,
                                 (features,), self.param_dtype)
+            y = y * weight.reshape(shape)
+        if use_bias:
             bias = self.param("bias", nn.initializers.zeros,
                               (features,), self.param_dtype)
-            y = y * weight.reshape(shape) + bias.reshape(shape)
+            y = y + bias.reshape(shape)
         if self.fuse_relu:
             y = jnp.maximum(y, 0.0)
         return y.astype(x.dtype)
@@ -126,7 +141,9 @@ def convert_syncbn_model(module: nn.Module, axis_name: str = "dp") -> nn.Module:
         return SyncBatchNorm(
             eps=module.epsilon,
             momentum=1.0 - module.momentum,
-            affine=module.use_scale and module.use_bias,
+            use_scale=module.use_scale,
+            use_bias=module.use_bias,
+            channel_axis=module.axis if isinstance(module.axis, int) else -1,
             axis_name=axis_name,
         )
 

@@ -1,0 +1,34 @@
+"""apex_tpu.transformer — Megatron-style model parallelism on a jax Mesh.
+
+Parity target: ``apex.transformer`` (SURVEY.md §2.3 L6): parallel_state,
+tensor_parallel, pipeline_parallel, microbatches, amp.GradScaler, functional
+(fused softmax/rope), layers, _data.
+"""
+
+import importlib as _importlib
+
+from apex_tpu.transformer import parallel_state  # light, always loaded
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelType
+
+_SUBMODULES = (
+    "tensor_parallel",
+    "pipeline_parallel",
+    "functional",
+    "layers",
+    "amp",
+    "testing",
+    "_data",
+    "log_util",
+)
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        module = _importlib.import_module(f"apex_tpu.transformer.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'apex_tpu.transformer' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals().keys()) + list(_SUBMODULES))

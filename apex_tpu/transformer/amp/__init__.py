@@ -1,0 +1,39 @@
+"""apex_tpu.transformer.amp — model-parallel-aware grad scaler.
+
+Parity: ``apex.transformer.amp.GradScaler`` (amp/grad_scaler.py:21-60): a
+GradScaler whose ``found_inf`` is all-reduced across the **model-parallel
+ranks** (tp × pp) before the step/skip decision, so every shard of one model
+replica skips together.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.transformer.parallel_state import (
+    PIPELINE_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+)
+
+__all__ = ["GradScaler"]
+
+
+class GradScaler(LossScaler):
+    """LossScaler that syncs found_inf over the model-parallel axes.
+
+    Use inside shard_map over ('pp','tp') (or whichever subset exists):
+    ``unscale`` ORs the overflow flag across those axes (grad_scaler.py:38-60
+    does MAX over the model-parallel group).
+    """
+
+    def unscale(self, grads: Any, state: LossScalerState):
+        unscaled, found_inf = super().unscale(grads, state)
+        for axis in (TENSOR_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS):
+            try:
+                found_inf = jax.lax.pmax(found_inf.astype(jax.numpy.float32), axis) > 0
+            except NameError:
+                continue
+        return unscaled, found_inf

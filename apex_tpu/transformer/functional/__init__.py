@@ -1,0 +1,20 @@
+"""apex_tpu.transformer.functional — fused softmax dispatcher + fused rope.
+
+Parity: apex/transformer/functional (fused_softmax.py:164-275, fused_rope.py).
+"""
+
+from apex_tpu.ops.rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+from apex_tpu.transformer.functional.fused_softmax import FusedScaleMaskSoftmax
+
+__all__ = [
+    "FusedScaleMaskSoftmax",
+    "fused_apply_rotary_pos_emb",
+    "fused_apply_rotary_pos_emb_2d",
+    "fused_apply_rotary_pos_emb_cached",
+    "fused_apply_rotary_pos_emb_thd",
+]

@@ -1,0 +1,106 @@
+"""FusedScaleMaskSoftmax — the kernel-dispatch wrapper.
+
+Parity target: ``apex.transformer.functional.fused_softmax``
+(fused_softmax.py:164-275): one module that routes scale+mask+softmax to the
+right fused kernel (causal / masked / generic / plain) or the eager fallback,
+based on dtype, mask type, and shape predicates
+(``is_kernel_available``: fp16/bf16, 16 < sk ≤ 2048|16384, pow2-ish batching).
+
+On TPU the Pallas kernels have different (weaker) constraints — lane-aligned
+sk under a VMEM cap (see :mod:`apex_tpu.ops.softmax`) — and the jnp fallback
+is itself fused by XLA, so dispatch cannot change numerics, only speed.  The
+predicate structure is preserved for API parity and introspection.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    _MAX_SK,
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.transformer.enums import AttnMaskType
+
+__all__ = ["FusedScaleMaskSoftmax"]
+
+
+class FusedScaleMaskSoftmax:
+    """fused operation: scaling + mask + softmax (fused_softmax.py:164).
+
+    Args mirror the reference: ``input_in_fp16``/``input_in_bf16`` describe
+    the activation dtype, ``attn_mask_type`` selects causal vs padding,
+    ``scaled_masked_softmax_fusion`` enables the kernel path,
+    ``mask_func``/``softmax_in_fp32``/``scale`` configure the fallback.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func: Optional[Callable] = None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+    ):
+        if input_in_fp16 and input_in_bf16:
+            raise RuntimeError("both fp16 and bf16 flags cannot be active at the same time.")
+        if scale is not None and not softmax_in_fp32:
+            raise RuntimeError("softmax should be in fp32 when scaled")
+        self.input_in_fp16 = input_in_fp16
+        self.input_in_bf16 = input_in_bf16
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """Shape predicate (fused_softmax.py:196-236, TPU constraints)."""
+        if not self.scaled_masked_softmax_fusion:
+            return False
+        if not self.input_in_float16:
+            # the CUDA kernels are half-only; the Pallas kernels aren't, but
+            # keep the predicate shape for parity.
+            pass
+        if sk % 128 != 0 or sk > _MAX_SK:
+            return False
+        if sq % min(128, sq) != 0 or sq < 8:
+            return False
+        return True
+
+    def __call__(self, inputs, mask=None):
+        b, np_, sq, sk = inputs.shape
+        scale = self.scale if self.scale is not None else 1.0
+        if self.attn_mask_type == AttnMaskType.causal:
+            if mask is not None:
+                # the causal kernel ignores an explicit mask (the reference
+                # asserts mask is None for the upper-triang path)
+                return scaled_masked_softmax(inputs, mask, scale)
+            return scaled_upper_triang_masked_softmax(inputs, scale)
+        if mask is not None:
+            if self.is_kernel_available(mask, b, np_, sq, sk):
+                return scaled_masked_softmax(inputs, mask, scale)
+            return generic_scaled_masked_softmax(inputs, mask, scale)
+        return scaled_softmax(inputs, scale)
+
+    # keep the reference's name for the eager path
+    def forward_torch_softmax(self, inputs, mask=None):
+        x = inputs.astype(jnp.float32) if self.softmax_in_fp32 else inputs
+        if self.scale is not None:
+            x = x * self.scale
+        if mask is not None and self.mask_func is not None:
+            x = self.mask_func(x, mask)
+        import jax
+
+        probs = jax.nn.softmax(x, axis=-1)
+        if self.softmax_in_fp32 and self.input_in_float16:
+            probs = probs.astype(inputs.dtype)
+        return probs

@@ -1,0 +1,64 @@
+"""apex_tpu.transformer.layers — norm layers aware of sequence parallelism.
+
+Parity: ``apex.transformer.layers.FusedLayerNorm``
+(layers/layer_norm.py:26-88): a LayerNorm whose params are tagged
+``sequence_parallel_enabled`` so the trainer all-reduces their grads across
+the TP group (under SP, each rank sees only s/tp of the tokens, so LN param
+grads are partial sums).
+
+TPU design: the tagging mechanism becomes explicit — the module reduces its
+*gradient contributions* via the custom-vjp trick below instead of asking the
+trainer to find tagged params: a ``psum``-in-backward wrapper around the
+params makes the grads come out already reduced, which composes with any
+optimizer.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.normalization import FusedLayerNorm as _BaseLayerNorm
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+)
+
+__all__ = ["FusedLayerNorm"]
+
+
+class FusedLayerNorm(nn.Module):
+    """LayerNorm for (optionally) sequence-parallel activations.
+
+    With ``sequence_parallel_enabled`` the input is [s/tp, b, h] per rank;
+    normalization is per-token so the forward needs no communication, and the
+    weight/bias grads are all-reduced across tp in backward via
+    ``copy_to_tensor_model_parallel_region`` applied to the params (identity
+    fwd / psum bwd — exactly the grad-sync the reference defers to the
+    trainer, layer_norm.py:26-52).
+    """
+
+    hidden_size: int
+    eps: float = 1e-5
+    memory_efficient: bool = False
+    sequence_parallel_enabled: bool = False
+    axis_name: str = TENSOR_PARALLEL_AXIS
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        weight = self.param("scale", nn.initializers.ones,
+                            (self.hidden_size,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.hidden_size,), self.param_dtype)
+        if self.sequence_parallel_enabled:
+            weight = copy_to_tensor_model_parallel_region(weight, self.axis_name)
+            bias = copy_to_tensor_model_parallel_region(bias, self.axis_name)
+        return fused_layer_norm_affine(x, weight, bias, (self.hidden_size,),
+                                       self.eps,
+                                       memory_efficient=self.memory_efficient)

@@ -1,0 +1,14 @@
+"""Logging-level helpers (apex/transformer/log_util.py parity)."""
+
+from __future__ import annotations
+
+import logging
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    return logging.getLogger(f"apex_tpu.transformer.{name}")
+
+
+def set_logging_level(verbosity) -> None:
+    """Set the apex_tpu root logger level (log_util.set_logging_level)."""
+    logging.getLogger("apex_tpu").setLevel(verbosity)

@@ -1,0 +1,146 @@
+"""Microbatch calculators, including batch-size rampup.
+
+Parity target: ``apex.transformer.microbatches`` (microbatches.py:26-168) and
+``setup_microbatch_calculator`` (pipeline_parallel/utils.py:58-104): the
+global singleton that answers ``get_micro_batch_size`` /
+``get_num_microbatches`` / ``get_current_global_batch_size``, with a
+constant and a ramp-up implementation.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = [
+    "build_num_microbatches_calculator",
+    "ConstantNumMicroBatches",
+    "RampupBatchsizeNumMicroBatches",
+]
+
+
+def build_num_microbatches_calculator(
+    rank: int,
+    rampup_batch_size: Optional[List[int]],
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+):
+    """microbatches.py:26-63 parity (same validation and selection)."""
+    if rampup_batch_size is None:
+        calculator = ConstantNumMicroBatches(
+            global_batch_size, micro_batch_size, data_parallel_size)
+        if rank == 0:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "setting number of micro-batches to constant %d",
+                calculator.get())
+    else:
+        if len(rampup_batch_size) != 3:
+            raise ValueError(
+                "expected the following format: --rampup-batch-size "
+                "<start batch size> <batch size increment> <ramp-up samples>")
+        start_batch_size = int(rampup_batch_size[0])
+        batch_size_increment = int(rampup_batch_size[1])
+        ramup_samples = int(rampup_batch_size[2])
+        calculator = RampupBatchsizeNumMicroBatches(
+            start_batch_size, batch_size_increment, ramup_samples,
+            global_batch_size, micro_batch_size, data_parallel_size)
+    return calculator
+
+
+class NumMicroBatchesCalculator:
+    def __init__(self):
+        self.num_micro_batches = None
+        self.current_global_batch_size = None
+
+    def get(self):
+        return self.num_micro_batches
+
+    def get_current_global_batch_size(self):
+        return self.current_global_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        raise NotImplementedError
+
+
+class ConstantNumMicroBatches(NumMicroBatchesCalculator):
+    """microbatches.py:66-84."""
+
+    def __init__(self, global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        micro_batch_times_data_parallel = micro_batch_size * data_parallel_size
+        if global_batch_size % micro_batch_times_data_parallel != 0:
+            raise AssertionError(
+                f"global batch size ({global_batch_size}) is not divisible by "
+                f"micro batch size ({micro_batch_size}) times data parallel "
+                f"size ({data_parallel_size})")
+        self.num_micro_batches = global_batch_size // micro_batch_times_data_parallel
+        if self.num_micro_batches < 1:
+            raise AssertionError("number of micro-batches should be at least 1")
+        self.current_global_batch_size = global_batch_size
+        self.micro_batch_size = micro_batch_size
+
+    def update(self, consumed_samples, consistency_check):
+        pass
+
+
+class RampupBatchsizeNumMicroBatches(NumMicroBatchesCalculator):
+    """Batch-size rampup (microbatches.py:87-168): global batch grows from
+    ``start_batch_size`` by ``batch_size_increment`` every
+    ``rampup_samples / steps`` consumed samples."""
+
+    def __init__(self, start_batch_size, batch_size_increment, ramup_samples,
+                 global_batch_size, micro_batch_size, data_parallel_size):
+        super().__init__()
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_size = data_parallel_size
+        self.micro_batch_times_data_parallel_size = (
+            micro_batch_size * data_parallel_size)
+        if self.micro_batch_times_data_parallel_size <= 0:
+            raise AssertionError
+        if start_batch_size <= 0:
+            raise AssertionError
+        self.start_batch_size = start_batch_size
+        if global_batch_size <= 0:
+            raise AssertionError
+        self.global_batch_size = global_batch_size
+        diff_batch_size = self.global_batch_size - self.start_batch_size
+        if diff_batch_size < 0:
+            raise AssertionError(
+                "expected global batch size to be greater than or equal to "
+                "start batch size")
+        if batch_size_increment <= 0:
+            raise AssertionError
+        self.batch_size_increment = batch_size_increment
+        if diff_batch_size % batch_size_increment != 0:
+            raise AssertionError(
+                "expected gbs interval ({}) to be divisible by batch size "
+                "increment ({})".format(diff_batch_size, batch_size_increment))
+        num_increments = diff_batch_size // self.batch_size_increment
+        self.ramup_samples = ramup_samples
+        if self.ramup_samples < 0:
+            raise AssertionError
+        self.rampup_samples_per_increment = self.ramup_samples / num_increments
+        self.update(0, False)
+
+    def update(self, consumed_samples, consistency_check):
+        if consumed_samples > self.ramup_samples:
+            self.current_global_batch_size = self.global_batch_size
+        else:
+            steps = int(consumed_samples / self.rampup_samples_per_increment)
+            self.current_global_batch_size = (
+                self.start_batch_size + steps * self.batch_size_increment)
+            if self.current_global_batch_size > self.global_batch_size:
+                raise AssertionError
+        if consistency_check:
+            if (self.current_global_batch_size
+                    % self.micro_batch_times_data_parallel_size != 0):
+                raise AssertionError(
+                    "current global batch size ({}) is not divisible by "
+                    "micro-batch-size ({}) times data parallel size ({})".format(
+                        self.current_global_batch_size, self.micro_batch_size,
+                        self.data_parallel_size))
+        self.num_micro_batches = (
+            self.current_global_batch_size
+            // self.micro_batch_times_data_parallel_size)

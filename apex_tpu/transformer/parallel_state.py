@@ -1,0 +1,237 @@
+"""Model-parallel topology state — the Megatron ``mpu`` on a jax Mesh.
+
+Parity target: ``apex.transformer.parallel_state`` (parallel_state.py:155-760):
+``initialize_model_parallel(tp, pp, vpp)`` builds TP/PP/DP (+embedding)
+process groups from the world; getters expose per-rank group handles, ranks,
+and world sizes; virtual-pipeline rank state lives here too.
+
+TPU-native design (SURVEY.md §2.5): ONE ``jax.sharding.Mesh`` with axes
+``('dp', 'pp', 'tp')`` replaces every process group.  Axis order encodes the
+topology the reference configures by hand with ``NUM_GPUS_PER_IB_BLOCK`` /
+NCCL_NET routing: the *last* mesh axis maps to the fastest (most-adjacent)
+device dimension, so ``tp`` rides intra-slice ICI while ``dp`` spans the
+slower (DCN) dimension — the same placement Megatron's rank-ordering achieves.
+Group getters become axis names (for ``shard_map`` collectives) and mesh-shape
+queries; *rank* getters are traced values (``lax.axis_index``) only meaningful
+inside a mapped context, exactly like the reference's getters are only
+meaningful after ``init_process_group``.
+
+Multi-host: call :func:`initialize_distributed` (wraps
+``jax.distributed.initialize``) first; the mesh then spans all hosts'
+devices.  ``default_backend``/``p2p_backend`` (UCC vs NCCL, parallel_state.py
+:162-211) have no TPU meaning — ICI/DCN routing is the mesh layout — so they
+are accepted and ignored.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names (the reference's group names)
+DATA_PARALLEL_AXIS = "dp"
+PIPELINE_PARALLEL_AXIS = "pp"
+TENSOR_PARALLEL_AXIS = "tp"
+
+# Module-level state, mirroring the reference's group globals
+# (parallel_state.py:31-66).
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PIPELINE_WORLD_SIZE: Optional[int] = None
+_VIRTUAL_PIPELINE_RANK: Optional[int] = None
+_PIPELINE_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> None:
+    """Multi-host init (``torch.distributed.init_process_group`` analog).
+
+    Wraps ``jax.distributed.initialize``; on single-host or when the TPU
+    runtime auto-detects the topology, it is a no-op-safe call.
+    """
+    try:
+        jax.distributed.initialize(coordinator_address, num_processes, process_id)
+    except (ValueError, RuntimeError):
+        # already initialized, or single-process run
+        pass
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size_: int = 1,
+    pipeline_model_parallel_size_: int = 1,
+    virtual_pipeline_model_parallel_size_: Optional[int] = None,
+    pipeline_model_parallel_split_rank_: Optional[int] = None,
+    *,
+    devices: Optional[Sequence] = None,
+    default_backend: Optional[str] = None,
+    p2p_backend: Optional[str] = None,
+) -> Mesh:
+    """Build and install the global ('dp','pp','tp') mesh.
+
+    Parity: parallel_state.py:155-418.  world = dp × pp × tp must divide the
+    device count exactly, with the same validation errors.  Device order maps
+    tp to the innermost (fastest/ICI-adjacent) axis.
+    """
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+
+    del default_backend, p2p_backend  # no TPU meaning; see module docstring
+    devs = list(devices) if devices is not None else list(jax.devices())
+    world = len(devs)
+    tp = tensor_model_parallel_size_
+    pp = pipeline_model_parallel_size_
+    if world % (tp * pp) != 0:
+        raise RuntimeError(
+            f"world size ({world}) is not divisible by tensor parallel size "
+            f"({tp}) times pipeline parallel size ({pp})")
+    dp = world // (tp * pp)
+    if virtual_pipeline_model_parallel_size_ is not None and pp < 2:
+        raise RuntimeError(
+            "pipeline-model-parallel size should be greater than 1 with "
+            "interleaved schedule")
+    _VIRTUAL_PIPELINE_WORLD_SIZE = virtual_pipeline_model_parallel_size_
+    _VIRTUAL_PIPELINE_RANK = 0 if virtual_pipeline_model_parallel_size_ else None
+    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank_
+
+    # Megatron rank order is tp-fastest, then dp, then pp
+    # (parallel_state.py:237-266: tp groups are contiguous ranks).  jax
+    # device order is ICI-adjacent-first, so tp must be the *last* mesh dim.
+    arr = np.array(devs).reshape(pp, dp, tp).transpose(1, 0, 2)  # (dp, pp, tp)
+    _MESH = Mesh(arr, (DATA_PARALLEL_AXIS, PIPELINE_PARALLEL_AXIS,
+                       TENSOR_PARALLEL_AXIS))
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise AssertionError("model parallel mesh is not initialized")
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """parallel_state.py:761 parity."""
+    global _MESH, _VIRTUAL_PIPELINE_WORLD_SIZE, _VIRTUAL_PIPELINE_RANK
+    global _PIPELINE_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PIPELINE_WORLD_SIZE = None
+    _VIRTUAL_PIPELINE_RANK = None
+    _PIPELINE_SPLIT_RANK = None
+
+
+# --- "group" getters: axis names for shard_map collectives -----------------
+
+
+def get_tensor_model_parallel_group() -> str:
+    get_mesh()
+    return TENSOR_PARALLEL_AXIS
+
+
+def get_pipeline_model_parallel_group() -> str:
+    get_mesh()
+    return PIPELINE_PARALLEL_AXIS
+
+
+def get_data_parallel_group() -> str:
+    get_mesh()
+    return DATA_PARALLEL_AXIS
+
+
+def get_embedding_group() -> str:
+    """First+last pp stages share embedding grads (parallel_state.py:282-305).
+
+    On a mesh this is not a separate group: the embedding-grad allreduce is a
+    masked psum over the pp axis (see pipeline_parallel.utils).
+    """
+    return PIPELINE_PARALLEL_AXIS
+
+
+# --- world sizes (static, from mesh shape) ---------------------------------
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return get_mesh().shape[TENSOR_PARALLEL_AXIS]
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return get_mesh().shape[PIPELINE_PARALLEL_AXIS]
+
+
+def get_data_parallel_world_size() -> int:
+    return get_mesh().shape[DATA_PARALLEL_AXIS]
+
+
+def get_model_parallel_world_size() -> int:
+    return get_tensor_model_parallel_world_size() * get_pipeline_model_parallel_world_size()
+
+
+# --- ranks (traced; valid inside shard_map/pmap over the mesh) -------------
+
+
+def get_tensor_model_parallel_rank():
+    return jax.lax.axis_index(TENSOR_PARALLEL_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_PARALLEL_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_PARALLEL_AXIS)
+
+
+def is_pipeline_first_stage(ignore_virtual: bool = False):
+    """Traced predicate (parallel_state.py:589-610)."""
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != 0:
+            return False
+    return get_pipeline_model_parallel_rank() == 0
+
+
+def is_pipeline_last_stage(ignore_virtual: bool = False):
+    if not ignore_virtual and _VIRTUAL_PIPELINE_WORLD_SIZE is not None:
+        if _VIRTUAL_PIPELINE_RANK != _VIRTUAL_PIPELINE_WORLD_SIZE - 1:
+            return False
+    return (get_pipeline_model_parallel_rank()
+            == get_pipeline_model_parallel_world_size() - 1)
+
+
+# --- virtual pipeline state (parallel_state.py:54-55, 675-697) -------------
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_WORLD_SIZE
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PIPELINE_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PIPELINE_RANK
+    _VIRTUAL_PIPELINE_RANK = rank
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PIPELINE_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: int) -> None:
+    global _PIPELINE_SPLIT_RANK
+    _PIPELINE_SPLIT_RANK = rank
+
+
+def get_rank_info() -> str:
+    """Short rank descriptor for logging (parallel_state.py get_rank_info)."""
+    if _MESH is None:
+        return "uninitialized"
+    return (f"mesh(dp={get_data_parallel_world_size()}, "
+            f"pp={get_pipeline_model_parallel_world_size()}, "
+            f"tp={get_tensor_model_parallel_world_size()}), "
+            f"process={jax.process_index()}")

@@ -1,0 +1,35 @@
+"""apex_tpu.transformer.pipeline_parallel — pipeline schedules over the pp axis.
+
+Parity: apex/transformer/pipeline_parallel (SURVEY.md §2.3): p2p layer,
+no-pipelining / 1F1B / interleaved schedules, microbatch utils, timers.
+"""
+
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    PipelineStageSpec,
+    build_model,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    get_current_global_batch_size,
+    get_micro_batch_size,
+    get_num_microbatches,
+    setup_microbatch_calculator,
+    update_num_microbatches,
+)
+
+__all__ = [
+    "PipelineStageSpec",
+    "build_model",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_without_interleaving",
+    "get_forward_backward_func",
+    "get_current_global_batch_size",
+    "get_micro_batch_size",
+    "get_num_microbatches",
+    "setup_microbatch_calculator",
+    "update_num_microbatches",
+]

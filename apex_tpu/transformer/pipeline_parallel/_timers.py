@@ -1,0 +1,89 @@
+"""Wall timers with optional device sync + TensorBoard export.
+
+Parity: ``apex.transformer.pipeline_parallel._timers`` (_timers.py:6-79):
+named timers with ``start/stop/elapsed/log/write``; the reference's
+``torch.cuda.synchronize`` option maps to ``jax.block_until_ready`` on a
+token (or the caller's outputs) — on TPU, dispatch is async exactly like CUDA.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = time.time()
+
+    def start(self, barrier: bool = False):
+        if self.started_:
+            raise AssertionError("timer has already been started")
+        if barrier:
+            jax.effects_barrier()
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, barrier: bool = False):
+        if not self.started_:
+            raise AssertionError("timer is not started")
+        if barrier:
+            jax.effects_barrier()
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """Group of named timers (_timers.py Timers)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def write(self, names: List[str], writer, iteration: int,
+              normalizer: float = 1.0, reset: bool = False):
+        """TensorBoard export (_timers.py:52-64); ``writer`` is any object
+        with ``add_scalar(tag, value, step)``."""
+        if normalizer <= 0.0:
+            raise AssertionError
+        for name in names:
+            value = self.timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", value, iteration)
+
+    def log(self, names: List[str], normalizer: float = 1.0,
+            reset: bool = True) -> str:
+        if normalizer <= 0.0:
+            raise AssertionError
+        parts = ["time (ms)"]
+        for name in names:
+            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+            parts.append(f" | {name}: {t:.2f}")
+        line = "".join(parts)
+        import logging
+
+        logging.getLogger(__name__).info(line)
+        return line

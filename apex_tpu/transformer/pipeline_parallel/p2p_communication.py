@@ -1,0 +1,103 @@
+"""Pipeline p2p communication over the pp mesh axis.
+
+Parity target: ``apex.transformer.pipeline_parallel.p2p_communication``
+(p2p_communication.py:34-690): ``_communicate`` + the nine public
+send/recv combinators built on ``batch_isend_irecv``.
+
+TPU-native design (SURVEY.md §7): point-to-point sends between pipeline
+neighbors are ``jax.lax.ppermute`` shifts over the ``pp`` axis — deadlock-free
+by construction (one collective, not paired isend/irecv), riding ICI.  In
+SPMD there is no separate "send" and "recv": a shift both sends this rank's
+tensor and delivers the neighbor's, so each reference combinator maps to a
+shift direction:
+
+- send_forward / recv_forward           → :func:`shift_forward`
+- send_backward / recv_backward         → :func:`shift_backward`
+- send_forward_recv_backward            → shift_forward + shift_backward
+  (XLA schedules both permutes concurrently on opposite ICI directions)
+- shape negotiation (`tensor_shape`, p2p_communication.py:168-232) is
+  unnecessary: shapes are static under jit.
+- ``scatter_gather_tensors_in_pipeline`` (chunking over tp before the wire)
+  is XLA's job; accepted and ignored where it appears in signatures.
+
+The reference's fp32-residual dtype rule (`dtype_` override for fp32 residual
+connections) maps to passing the tensor in whatever dtype it has — ppermute
+is dtype-preserving.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+
+
+def _pp_size(axis_name):
+    return jax.lax.psum(1, axis_name)
+
+
+def shift_forward(x: Any, axis_name: str = PIPELINE_PARALLEL_AXIS,
+                  wrap: bool = False) -> Any:
+    """Deliver each stage's tensor to the *next* stage (stage 0 receives
+    zeros, or the last stage's tensor when ``wrap`` — the interleaved
+    schedule's circular edge)."""
+    n = _pp_size(axis_name)
+
+    def shift(leaf):
+        perm = [(i, (i + 1) % n) for i in range(n if wrap else n - 1)]
+        return jax.lax.ppermute(leaf, axis_name, perm)
+
+    return jax.tree.map(shift, x)
+
+
+def shift_backward(x: Any, axis_name: str = PIPELINE_PARALLEL_AXIS,
+                   wrap: bool = False) -> Any:
+    """Deliver each stage's tensor to the *previous* stage."""
+    n = _pp_size(axis_name)
+
+    def shift(leaf):
+        perm = [((i + 1) % n, i) for i in range(n if wrap else n - 1)]
+        return jax.lax.ppermute(leaf, axis_name, perm)
+
+    return jax.tree.map(shift, x)
+
+
+# --- reference-named combinators (p2p_communication.py:385-690) ------------
+
+
+def send_forward_recv_forward(output, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    """This stage's output goes to the next stage; returns what the previous
+    stage sent here."""
+    return shift_forward(output, axis_name)
+
+
+def send_backward_recv_backward(input_grad, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_backward(input_grad, axis_name)
+
+
+def send_forward(output, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_forward(output, axis_name)
+
+
+def recv_forward(output, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_forward(output, axis_name)
+
+
+def send_backward(grad, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_backward(grad, axis_name)
+
+
+def recv_backward(grad, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_backward(grad, axis_name)
+
+
+def send_forward_recv_backward(output, grad, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    """The 1F1B steady-state exchange: activations flow down while grads flow
+    up, as two opposite-direction permutes XLA runs concurrently."""
+    return shift_forward(output, axis_name), shift_backward(grad, axis_name)
+
+
+def send_backward_recv_forward(grad, output, axis_name: str = PIPELINE_PARALLEL_AXIS):
+    return shift_backward(grad, axis_name), shift_forward(output, axis_name)

@@ -1,0 +1,36 @@
+"""Pipeline schedules (apex/transformer/pipeline_parallel/schedules parity)."""
+
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+    build_model,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (
+    forward_backward_no_pipelining,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_interleaving import (
+    forward_backward_pipelining_with_interleaving,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    forward_backward_pipelining_without_interleaving,
+    pipeline_loss,
+)
+
+__all__ = [
+    "PipelineStageSpec",
+    "build_model",
+    "forward_backward_no_pipelining",
+    "forward_backward_pipelining_with_interleaving",
+    "forward_backward_pipelining_without_interleaving",
+    "pipeline_loss",
+    "get_forward_backward_func",
+]
+
+
+def get_forward_backward_func(virtual_pipeline_model_parallel_size,
+                              pipeline_model_parallel_size):
+    """schedules/__init__.py get_forward_backward_func parity."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

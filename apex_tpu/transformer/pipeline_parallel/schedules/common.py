@@ -1,0 +1,86 @@
+"""Shared schedule machinery: build_model, stage specs, loss plumbing.
+
+Parity target: ``apex.transformer.pipeline_parallel.schedules.common``
+(common.py:30-420): ``build_model`` (virtual-pp returns a list of model
+chunks), ``forward_step``/``backward_step``, ``custom_backward``.
+
+TPU-native design: a pipeline-parallel model is described by a
+:class:`PipelineStageSpec` — one jittable ``stage_fn(params, x, extras)``
+applied by every pp rank to its own parameter shard, plus first/last-stage
+adapters.  Because every rank runs the same SPMD program, per-rank structural
+differences (embedding on stage 0, LM head on stage N-1) are expressed as
+``lax.cond`` on the stage index or — preferably — folded into ``stage_fn``
+with stage-sharded parameters (zero-size where unused).  The schedules
+differentiate straight through the whole pipeline (scan + ppermute), so
+``backward_step``/``custom_backward`` (manual vjp bookkeeping, common.py:219,
+325-420) have no analog: JAX's scan transpose IS the backward schedule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PipelineStageSpec", "build_model", "listify_model"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineStageSpec:
+    """One pipeline stage as a pure function.
+
+    - ``stage_fn(params, x)``: the per-rank transform applied at every stage
+      (e.g. a block of transformer layers).  ``x`` and the return value must
+      have identical shape/dtype (the inter-stage wire format).
+    - ``first_fn(params, batch)``: stage-0 input adapter (embedding); maps the
+      microbatch to the wire format.  Identity on other ranks' data is fine —
+      it only runs meaningfully where ``stage == 0``.
+    - ``last_fn(params, y, batch)``: final-stage head+loss; returns a scalar
+      loss for one microbatch.
+    """
+
+    stage_fn: Callable[[Any, Any], Any]
+    first_fn: Optional[Callable[[Any, Any], Any]] = None
+    last_fn: Optional[Callable[[Any, Any, Any], Any]] = None
+
+
+def listify_model(model) -> List[Any]:
+    """common.py listify_model parity."""
+    return list(model) if isinstance(model, (list, tuple)) else [model]
+
+
+def build_model(
+    model_provider_func: Callable,
+    wrap_with_ddp: bool = True,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    **kwargs,
+) -> List[Any]:
+    """Instantiate one model chunk per virtual pipeline stage
+    (common.py:30-151).
+
+    With virtual pp the provider is called vpp times with
+    ``pre_process``/``post_process`` flags describing whether the chunk
+    contains the input embedding / the head, exactly like the reference.
+    ``wrap_with_ddp`` has no wrapper to apply (grad sync is a sharding
+    property on TPU) and is accepted for parity.
+    """
+    from apex_tpu.transformer import parallel_state
+
+    if (parallel_state.get_pipeline_model_parallel_world_size() > 1
+            and virtual_pipeline_model_parallel_size is not None):
+        models = []
+        for i in range(virtual_pipeline_model_parallel_size):
+            parallel_state.set_virtual_pipeline_model_parallel_rank(i)
+            pre = i == 0
+            post = i == virtual_pipeline_model_parallel_size - 1
+            models.append(model_provider_func(
+                pre_process=pre, post_process=post, **kwargs))
+        return models
+    return [model_provider_func(pre_process=True, post_process=True, **kwargs)]
+
+
+def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(values * mask) / denom

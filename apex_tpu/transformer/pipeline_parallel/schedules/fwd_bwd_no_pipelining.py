@@ -1,0 +1,67 @@
+"""No-pipelining schedule: sequential microbatches with deferred grad sync.
+
+Parity target: ``forward_backward_no_pipelining``
+(fwd_bwd_no_pipelining.py:23): run fwd+bwd per microbatch under ``no_sync``
+(grad allreduce deferred), syncing only on the last microbatch.
+
+TPU-native: grads are accumulated functionally over a ``lax.scan`` of
+microbatches; the data-parallel reduction happens once on the summed grads
+(either by the caller's pjit sharding or the explicit ``ddp.sync``), which is
+exactly the deferred-sync semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["forward_backward_no_pipelining"]
+
+
+def forward_backward_no_pipelining(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    params: Any,
+    microbatches: Any,
+    *,
+    forward_only: bool = False,
+    grad_scaler=None,
+    scaler_state=None,
+) -> Tuple[jax.Array, Optional[Any]]:
+    """Returns (mean_loss, summed_grads or None).
+
+    ``loss_fn(params, microbatch) -> scalar``; ``microbatches`` is a pytree
+    whose leaves have a leading [num_microbatches, ...] dim.  When a
+    ``grad_scaler`` is given, each microbatch loss is scaled before backward
+    (common.py:253-420 semantics) and the returned grads are still *scaled*
+    (unscale with the scaler, as the reference's trainer does).
+    """
+    n_micro = jax.tree.leaves(microbatches)[0].shape[0]
+
+    def scaled_loss(p, mb):
+        loss = loss_fn(p, mb)
+        if grad_scaler is not None:
+            return grad_scaler.scale_loss(loss, scaler_state), loss
+        return loss, loss
+
+    if forward_only:
+        def fwd_body(acc, mb):
+            _, loss = scaled_loss(params, mb)
+            return acc + loss, None
+
+        total, _ = jax.lax.scan(fwd_body, jnp.zeros((), jnp.float32), microbatches)
+        return total / n_micro, None
+
+    grad_fn = jax.grad(scaled_loss, has_aux=True)
+
+    def body(carry, mb):
+        loss_acc, grad_acc = carry
+        g, loss = grad_fn(params, mb)
+        grad_acc = jax.tree.map(jnp.add, grad_acc, g)
+        return (loss_acc + loss, grad_acc), None
+
+    zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (total_loss, grads), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), zero_grads), microbatches)
+    return total_loss / n_micro, grads

@@ -1,0 +1,130 @@
+"""Interleaved (virtual-stage) pipelining over the pp mesh axis.
+
+Parity target: ``_forward_backward_pipelining_with_interleaving``
+(fwd_bwd_pipelining_with_interleaving.py:27-560): each rank owns
+``vpp`` model chunks; global stage ``s`` lives on rank ``s % pp`` as chunk
+``s // pp``, shrinking the pipeline bubble by ``vpp``.
+
+TPU-native design: the circular pipeline as one differentiable SPMD scan.
+Each tick, every rank applies ALL of its chunks (one per in-flight
+microbatch wave, the steady-state of the interleaved schedule); the wire is
+circular — ``ppermute`` with wrap-around, so a tensor leaving the last rank
+re-enters rank 0 at the next chunk.  Chunk bookkeeping that the reference
+does with virtual-rank state and host-side scheduling
+(parallel_state.py:675-697) collapses into the per-chunk buffers carried
+through the scan.  Backward is the scan/ppermute transpose, as in the
+non-interleaved schedule.
+
+Params for rank ``r`` are a pytree whose leaves are stacked over the chunk
+dim: leaf shape [vpp, ...] (``build_model`` with virtual pp returns the list
+to stack).  first/last adapters run at (chunk 0, rank 0) and
+(chunk vpp-1, rank pp-1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    _index_mb,
+)
+
+__all__ = ["forward_backward_pipelining_with_interleaving"]
+
+
+def _chunk_params(params: Any, v: int) -> Any:
+    return jax.tree.map(lambda l: l[v], params)
+
+
+def forward_backward_pipelining_with_interleaving(
+    spec: PipelineStageSpec,
+    params: Any,  # leaves stacked [vpp, ...]
+    batches: Any,
+    *,
+    num_model_chunks: int,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    checkpoint_stages: bool = True,
+    grad_scaler=None,
+    scaler_state=None,
+) -> Tuple[jax.Array, Optional[Any]]:
+    """Returns (mean_loss_on_all_ranks, grads_or_None); grads leaves are
+    stacked [vpp, ...] like the params."""
+    vpp = num_model_chunks
+    n_micro = jax.tree.leaves(batches)[0].shape[0]
+    p = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    scale = None
+    if grad_scaler is not None and scaler_state is not None:
+        scale = scaler_state.scale
+
+    stage_fn = spec.stage_fn
+    if checkpoint_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    wire0 = spec.first_fn(_chunk_params(params, 0), _index_mb(batches, 0))
+    wire_zero = jax.tree.map(jnp.zeros_like, wire0)
+
+    def loss_of(prms):
+        def tick(carry, t):
+            bufs = carry  # tuple of vpp wire buffers arriving at this rank
+            new_bufs = list(bufs)
+            loss_contrib = jnp.zeros((), jnp.float32)
+            shifted_prev = None  # chunk v-1's circular shift output
+            for v in range(vpp):
+                x = bufs[v]
+                if v == 0:
+                    # (chunk 0, rank 0) injects microbatch t
+                    inj = spec.first_fn(_chunk_params(prms, 0), _index_mb(batches, t))
+                    x = jax.tree.map(
+                        lambda a, b: jnp.where(rank == 0, a, b), inj, x)
+                y = stage_fn(_chunk_params(prms, v), x)
+
+                if v == vpp - 1:
+                    # (chunk vpp-1, rank p-1) emits microbatch t - (vpp*p - 1)
+                    out_idx = t - (vpp * p - 1)
+                    mb = _index_mb(batches, out_idx)
+                    loss_t = spec.last_fn(_chunk_params(prms, vpp - 1), y, mb)
+                    valid = jnp.logical_and(rank == p - 1, out_idx >= 0)
+                    loss_contrib = loss_t * valid.astype(jnp.float32)
+
+                # circular shift: rank p-1's output wraps to rank 0 — where it
+                # belongs to the NEXT chunk
+                perm = [(i, (i + 1) % p) for i in range(p)]
+                shifted = jax.tree.map(
+                    lambda l: jax.lax.ppermute(l, axis_name, perm), y)
+                # this rank's next input for chunk v: from rank-1 same chunk,
+                # except rank 0, whose chunk-v input is chunk v-1's wrap
+                if shifted_prev is None:
+                    new_bufs[v] = shifted  # rank 0 slot is overwritten by inj
+                else:
+                    new_bufs[v] = jax.tree.map(
+                        lambda w, s: jnp.where(rank == 0, w, s),
+                        shifted_prev, shifted)
+                shifted_prev = shifted
+            return tuple(new_bufs), loss_contrib
+
+        total_ticks = n_micro + vpp * p - 1
+        init = tuple(jax.tree.map(jnp.zeros_like, wire_zero) for _ in range(vpp))
+        _, losses = jax.lax.scan(tick, init, jnp.arange(total_ticks))
+        loss = jnp.sum(losses) / n_micro
+        if scale is not None:
+            loss = loss * scale
+        return loss
+
+    if forward_only:
+        return jax.lax.psum(loss_of(params), axis_name), None
+
+    local_loss, grads = jax.value_and_grad(loss_of)(params)
+    loss = jax.lax.psum(local_loss, axis_name)
+    if scale is not None:
+        loss = loss / scale
+    return loss, grads

@@ -1,0 +1,153 @@
+"""Pipelined fwd+bwd over the pp mesh axis (non-interleaved).
+
+Parity target: ``forward_backward_pipelining_without_interleaving`` — the
+1F1B schedule (fwd_bwd_pipelining_without_interleaving.py:241-520: warmup of
+``pp_size - rank - 1`` forwards, steady-state ``send_forward_recv_backward``,
+cooldown, deferred grad sync).
+
+TPU-native design (SURVEY.md §7 "Pipeline parallelism in JAX"): the schedule
+is ONE differentiable SPMD program — a ``lax.scan`` over
+``num_microbatches + pp - 1`` ticks in which every stage applies its layer
+block and passes activations to the next stage with ``ppermute``.  JAX's
+scan/ppermute transposition then *derives* the backward pipeline: cotangents
+flow through the inverse permutes in reverse tick order, which is exactly the
+cooldown/steady/warmup structure the reference hand-schedules, with the
+deferred grad sync falling out of grad accumulation over the scan.
+
+Differences vs the CUDA implementation, by design:
+
+- fwd and bwd are two sweeps (forward scan, transposed scan) rather than
+  interleaved 1F1B ticks; numerics are identical and on TPU both sweeps keep
+  every stage busy outside the same (pp-1)-tick bubbles.  Peak activation
+  memory is ``num_microbatches`` wire tensors per stage (GPipe profile) —
+  use ``checkpoint_stages=True`` (the reference's activation checkpointing,
+  :mod:`..random`) to keep only the wire tensors and recompute inside
+  stages; the interleaved schedule (smaller bubbles) is in
+  :mod:`.fwd_bwd_pipelining_with_interleaving`.
+- ``tensor_shape``/``dtype`` negotiation is unnecessary (static shapes).
+
+Run inside ``shard_map`` over the ``pp`` axis (composable with tp/dp axes).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+)
+
+__all__ = ["forward_backward_pipelining_without_interleaving", "pipeline_loss"]
+
+
+def _index_mb(batches: Any, i) -> Any:
+    """Select microbatch i (clamped) from [n_micro, ...] leaves."""
+    n = jax.tree.leaves(batches)[0].shape[0]
+    idx = jnp.clip(i, 0, n - 1)
+    return jax.tree.map(
+        lambda l: jax.lax.dynamic_index_in_dim(l, idx, 0, keepdims=False),
+        batches)
+
+
+def pipeline_loss(
+    spec: PipelineStageSpec,
+    params: Any,
+    batches: Any,
+    *,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    checkpoint_stages: bool = True,
+    loss_scale=None,
+) -> jax.Array:
+    """Mean microbatch loss of the full pipeline as one differentiable value.
+
+    Per-rank value is *masked to the last stage* (zero elsewhere) so that
+    ``jax.grad`` under shard_map's summed-loss convention optimizes exactly
+    the true loss; use ``lax.psum`` on the result for reporting.
+    """
+    n_micro = jax.tree.leaves(batches)[0].shape[0]
+    p = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+
+    stage_fn = spec.stage_fn
+    if checkpoint_stages:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    # wire template from the first-stage adapter on microbatch 0
+    wire0 = spec.first_fn(params, _index_mb(batches, 0))
+    wire_zero = jax.tree.map(jnp.zeros_like, wire0)
+
+    def tick(buf, t):
+        # stage 0 injects microbatch t; other stages consume the wire
+        inj = spec.first_fn(params, _index_mb(batches, t))
+        x = jax.tree.map(
+            lambda a, b: jnp.where(rank == 0, a, b), inj, buf)
+        y = stage_fn(params, x)
+
+        # last stage emits microbatch (t - (p-1))'s loss
+        out_idx = t - (p - 1)
+        mb = _index_mb(batches, out_idx)
+        loss_t = spec.last_fn(params, y, mb)
+        valid = jnp.logical_and(rank == p - 1, out_idx >= 0).astype(jnp.float32)
+        loss_contrib = loss_t * valid
+
+        perm = [(i, i + 1) for i in range(p - 1)]
+        nxt = jax.tree.map(
+            lambda l: jax.lax.ppermute(l, axis_name, perm), y)
+        return nxt, loss_contrib
+
+    total_ticks = n_micro + p - 1
+    _, losses = jax.lax.scan(tick, wire_zero, jnp.arange(total_ticks))
+    loss = jnp.sum(losses) / n_micro
+    if loss_scale is not None:
+        loss = loss * loss_scale
+    return loss
+
+
+def forward_backward_pipelining_without_interleaving(
+    spec: PipelineStageSpec,
+    params: Any,
+    batches: Any,
+    *,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    checkpoint_stages: bool = True,
+    grad_scaler=None,
+    scaler_state=None,
+    # accepted for reference-API familiarity; shapes are static under jit
+    tensor_shape=None,
+    dtype=None,
+    disable_autocast: bool = False,
+    deallocate_pipeline_outputs: bool = False,
+) -> Tuple[jax.Array, Optional[Any]]:
+    """Returns (mean_loss_on_all_ranks, grads_or_None).
+
+    ``spec``/``params``/``batches`` as in :func:`pipeline_loss`.  The loss
+    returned is psum'd over the pp axis so every rank reports the true value;
+    the grads are per-rank stage grads (the caller feeds them to its
+    optimizer; dp sync composes outside, as in the reference's deferred
+    ``custom_sync_context_handler``).  With ``grad_scaler`` the backward runs
+    on the scaled loss and grads come back *scaled*.
+    """
+    del tensor_shape, dtype, disable_autocast, deallocate_pipeline_outputs
+    scale = None
+    if grad_scaler is not None:
+        scale = scaler_state.scale if scaler_state is not None else None
+
+    loss_fn = functools.partial(
+        pipeline_loss, spec, batches=batches, axis_name=axis_name,
+        checkpoint_stages=checkpoint_stages, loss_scale=scale)
+
+    if forward_only:
+        local = loss_fn(params)
+        return jax.lax.psum(local, axis_name), None
+
+    local_loss, grads = jax.value_and_grad(loss_fn)(params)
+    loss = jax.lax.psum(local_loss, axis_name)
+    if scale is not None:
+        loss = loss / scale
+    return loss, grads

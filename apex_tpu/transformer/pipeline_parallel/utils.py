@@ -1,0 +1,189 @@
+"""Pipeline-parallel utilities (apex/transformer/pipeline_parallel/utils.py).
+
+Covers: microbatch-calculator singleton (utils.py:58-157), rank-0 printing
+(:159-177), mask/position-id builder (:200-250), loss averaging across dp,
+param-norm and memory reporting, ``unwrap_model``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_AUTORESUME = None
+_GLOBAL_TIMERS = None
+
+
+def setup_microbatch_calculator(rank: int, rampup_batch_size: Optional[List[int]],
+                                global_batch_size: int, micro_batch_size: int,
+                                data_parallel_size: int) -> None:
+    """utils.py:58-104 parity (global singleton with ensure-none check)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is not None:
+        raise AssertionError("num microbatches calculator is already initialized.")
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def _reconfigure_microbatch_calculator(rank, rampup_batch_size,
+                                       global_batch_size, micro_batch_size,
+                                       data_parallel_size) -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        rank, rampup_batch_size, global_batch_size, micro_batch_size,
+        data_parallel_size)
+
+
+def destroy_microbatch_calculator() -> None:
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+
+
+def get_micro_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.micro_batch_size
+
+
+def get_num_microbatches():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get()
+
+
+def get_current_global_batch_size():
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR.get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples, consistency_check=True):
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR.update(consumed_samples, consistency_check)
+
+
+def get_autoresume():
+    """ADLR autoresume hook (utils.py:142); no TPU-cluster analog, returns
+    the registered object or None."""
+    return _GLOBAL_AUTORESUME
+
+
+def get_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        from apex_tpu.transformer.pipeline_parallel._timers import Timers
+
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS
+
+
+def print_rank_0(message: str) -> None:
+    """Only host process 0 prints (utils.py:159)."""
+    if jax.process_index() == 0:
+        print(message, flush=True)
+
+
+def is_last_rank() -> bool:
+    return jax.process_index() == jax.process_count() - 1
+
+
+def print_rank_last(message: str) -> None:
+    if is_last_rank():
+        print(message, flush=True)
+
+
+def listify_model(model: Any) -> List[Any]:
+    return list(model) if isinstance(model, (list, tuple)) else [model]
+
+
+def unwrap_model(model, module_instances=None):
+    """utils.py unwrap_model parity: no wrapper types exist here, identity
+    per chunk."""
+    return_list = True
+    if not isinstance(model, list):
+        model = [model]
+        return_list = False
+    unwrapped = list(model)
+    if not return_list:
+        return unwrapped[0]
+    return unwrapped
+
+
+def get_ltor_masks_and_position_ids(data, eod_token=None,
+                                    reset_position_ids: bool = False,
+                                    reset_attention_mask: bool = False,
+                                    eod_mask_loss: bool = False):
+    """Left-to-right masks + position ids (utils.py:200-250).
+
+    Returns (attention_mask [b,1,s,s] bool where True = MASKED OUT,
+    loss_mask [b,s] fp32, position_ids [b,s] int32).  The per-document reset
+    options require host-side loops in the reference; here they are computed
+    vectorized so the whole builder stays jittable.
+    """
+    b, s = data.shape
+    # causal: True above the diagonal = masked
+    att = jnp.triu(jnp.ones((s, s), jnp.bool_), k=1)
+    att = jnp.broadcast_to(att, (b, 1, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    if eod_token is not None and reset_position_ids:
+        # position restarts after each EOD: pos[i] = i - (index of last EOD ≤ i)
+        is_eod = (data == eod_token).astype(jnp.int32)
+        # last EOD position before or at i (exclusive of i itself)
+        eod_before = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.int32), is_eod[:, :-1]], axis=1)
+        seg_start = jax.lax.cummax(
+            jnp.where(eod_before == 1,
+                      jnp.arange(s, dtype=jnp.int32)[None, :], 0), axis=1)
+        position_ids = jnp.arange(s, dtype=jnp.int32)[None, :] - seg_start
+    if eod_token is not None and reset_attention_mask:
+        is_eod = (data == eod_token).astype(jnp.int32)
+        eod_before = jnp.concatenate(
+            [jnp.zeros((b, 1), jnp.int32), is_eod[:, :-1]], axis=1)
+        seg_id = jnp.cumsum(eod_before, axis=1)  # [b, s]
+        same_seg = seg_id[:, :, None] == seg_id[:, None, :]
+        att = jnp.logical_or(att, jnp.logical_not(same_seg)[:, None, :, :])
+    return att, loss_mask, position_ids
+
+
+def average_losses_across_data_parallel_group(losses,
+                                              axis_name: str = DATA_PARALLEL_AXIS):
+    """utils.py:253 parity; call inside shard_map/pmap over dp."""
+    stacked = jnp.stack([jnp.asarray(l, jnp.float32) for l in losses])
+    return jax.lax.pmean(stacked, axis_name)
+
+
+def calc_params_l2_norm(params, across_model_parallel: bool = True):
+    """Global fp32 L2 norm of params (utils.py calc_params_l2_norm)."""
+    from apex_tpu.utils.tree_math import tree_l2norm
+
+    return tree_l2norm(params)
+
+
+def report_memory(name: str) -> str:
+    """utils.py:253 report_memory — TPU HBM stats via device memory stats."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        stats = {}
+    used = stats.get("bytes_in_use", 0) / 2**30
+    peak = stats.get("peak_bytes_in_use", 0) / 2**30
+    limit = stats.get("bytes_limit", 0) / 2**30
+    msg = (f"[{name}] memory (GiB) | in use: {used:.2f} | "
+           f"peak: {peak:.2f} | limit: {limit:.2f}")
+    print_rank_0(msg)
+    return msg
+
+
+def print_params_min_max_norm(params) -> None:
+    """utils.py:265 parity: per-leaf min/max/norm dump."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        leaf32 = leaf.astype(jnp.float32)
+        print_rank_0(
+            f"{jax.tree_util.keystr(path)}: min={float(leaf32.min()):.3e} "
+            f"max={float(leaf32.max()):.3e} "
+            f"norm={float(jnp.linalg.norm(leaf32)):.3e}")

@@ -1,0 +1,61 @@
+"""apex_tpu.transformer.tensor_parallel — Megatron TP primitives on a mesh.
+
+Parity: apex/transformer/tensor_parallel/__init__.py export surface.
+"""
+
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    copy_to_tensor_model_parallel_region,
+    gather_from_sequence_parallel_region,
+    gather_from_tensor_model_parallel_region,
+    reduce_from_tensor_model_parallel_region,
+    reduce_scatter_to_sequence_parallel_region,
+    scatter_to_sequence_parallel_region,
+    scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer, RingMemBuffer
+from apex_tpu.transformer.tensor_parallel.random import (
+    RNGStatesTracker,
+    checkpoint,
+    get_rng_state_tracker,
+    model_parallel_cuda_manual_seed,
+    model_parallel_seed,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (
+    VocabUtility,
+    divide,
+    split_tensor_along_last_dim,
+)
+
+__all__ = [
+    "vocab_parallel_cross_entropy",
+    "broadcast_data",
+    "ColumnParallelLinear",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "copy_to_tensor_model_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "MemoryBuffer",
+    "RingMemBuffer",
+    "RNGStatesTracker",
+    "checkpoint",
+    "get_rng_state_tracker",
+    "model_parallel_cuda_manual_seed",
+    "model_parallel_seed",
+    "VocabUtility",
+    "divide",
+    "split_tensor_along_last_dim",
+]

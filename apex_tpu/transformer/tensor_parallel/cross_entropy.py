@@ -1,0 +1,90 @@
+"""Vocab-parallel cross entropy (apex/transformer/tensor_parallel/cross_entropy.py:23-132).
+
+The logits' vocab dim is sharded across tp ranks; the loss is computed without
+gathering the full vocab:
+
+1. max over local shard → all-reduce(max) for stability,
+2. local masked gather of the target logit → all-reduce(sum),
+3. local sum(exp) → all-reduce(sum) → log,
+4. loss = log(sum_exp) - target_logit, optional label smoothing
+   (cross_entropy.py:85-108).
+
+The backward (softmax - one_hot, scaled) is derived by autodiff through the
+same collectives — each op here has the exact custom-vjp pairing Megatron
+hand-writes in ``_VocabParallelCrossEntropy.backward``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    reduce_from_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+
+__all__ = ["vocab_parallel_cross_entropy"]
+
+
+def vocab_parallel_cross_entropy(vocab_parallel_logits, target,
+                                 label_smoothing: float = 0.0,
+                                 axis_name: str = TENSOR_PARALLEL_AXIS):
+    """Per-token loss for [..., vocab/tp] logits and [...] int targets.
+
+    Runs inside shard_map over the tp axis (world size 1 works too, outside).
+    """
+    try:
+        world = jax.lax.psum(1, axis_name)
+        rank = jax.lax.axis_index(axis_name)
+        mapped = True
+    except NameError:
+        world, rank, mapped = 1, 0, False
+
+    logits32 = vocab_parallel_logits.astype(jnp.float32)
+    partition_vocab = logits32.shape[-1]
+
+    local_max = jnp.max(logits32, axis=-1)
+    if mapped:
+        global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
+    else:
+        global_max = jax.lax.stop_gradient(local_max)
+    # the max subtraction is for numerical stability only and carries no
+    # gradient (the reference's backward likewise ignores it)
+    logits32 = logits32 - global_max[..., None]
+
+    first, last = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        partition_vocab, rank, world)
+    in_range = jnp.logical_and(target >= first, target < last)
+    masked_target = jnp.where(in_range, target - first, 0)
+    target_logit = jnp.take_along_axis(
+        logits32, masked_target[..., None], axis=-1)[..., 0]
+    target_logit = jnp.where(in_range, target_logit, 0.0)
+
+    exp_logits = jnp.exp(logits32)
+    sum_exp = jnp.sum(exp_logits, axis=-1)
+    if mapped:
+        # psum with *identity* backward: the loss is replicated across tp
+        # ranks and each rank backpropagates the same cotangent once (raw
+        # lax.psum would re-sum cotangents — JAX's summed-loss convention —
+        # quadrupling grads).  Matches _VocabParallelCrossEntropy.backward.
+        target_logit = reduce_from_tensor_model_parallel_region(
+            target_logit, axis_name)
+        sum_exp = reduce_from_tensor_model_parallel_region(sum_exp, axis_name)
+
+    loss = jnp.log(sum_exp) - target_logit
+
+    if label_smoothing > 0:
+        # cross_entropy.py:85-108: smoothed loss mixes in the mean log-prob
+        # over the vocab.
+        vocab_size = partition_vocab * world
+        smoothing = label_smoothing * vocab_size / (vocab_size - 1)
+        log_probs = logits32 - jnp.log(sum_exp)[..., None]
+        mean_log_probs = jnp.sum(log_probs, axis=-1) / vocab_size
+        if mapped:
+            mean_log_probs = reduce_from_tensor_model_parallel_region(
+                mean_log_probs, axis_name)
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_probs
+
+    return loss

@@ -1,0 +1,48 @@
+"""Batch broadcast across the tensor-parallel group
+(apex/transformer/tensor_parallel/data.py:80 ``broadcast_data``).
+
+The reference moves the batch to rank 0 of each tp group and broadcasts
+(keys/sizes/flattened payload).  On TPU, data fed through
+``jax.device_put`` with a sharding that replicates over tp IS the broadcast —
+XLA materializes one copy per tp rank.  These helpers provide the same API
+for explicit shard_map code, plus the sharding constructor for pjit code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import (
+    DATA_PARALLEL_AXIS,
+    TENSOR_PARALLEL_AXIS,
+    get_mesh,
+)
+
+__all__ = ["broadcast_data", "tp_replicated_sharding"]
+
+
+def broadcast_data(keys, data: Dict[str, Any], datatype=None,
+                   axis_name: str = TENSOR_PARALLEL_AXIS) -> Dict[str, Any]:
+    """Make every tp rank see rank 0's values (inside shard_map).
+
+    Under jit the broadcast compiles away when the operand is already
+    replicated — matching the reference's intent (one host read per tp
+    group), not its mechanism.
+    """
+    out = {}
+    for k in keys:
+        v = jnp.asarray(data[k], datatype)
+        gathered = jax.lax.all_gather(v, axis_name)
+        out[k] = gathered[0]
+    return out
+
+
+def tp_replicated_sharding(batch_dim_over_dp: bool = True) -> NamedSharding:
+    """Sharding for input batches: dim 0 over dp, replicated over tp/pp."""
+    mesh = get_mesh()
+    spec = P(DATA_PARALLEL_AXIS) if batch_dim_over_dp else P()
+    return NamedSharding(mesh, spec)

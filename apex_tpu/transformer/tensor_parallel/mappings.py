@@ -1,0 +1,193 @@
+"""TP collective mappings with explicit forward/backward pairing.
+
+Parity target: ``apex.transformer.tensor_parallel.mappings``
+(mappings.py:141-301) — the Megatron f/g autograd functions:
+
+| reference                                | fwd            | bwd            |
+|------------------------------------------|----------------|----------------|
+| _CopyToModelParallelRegion               | identity       | all-reduce     |
+| _ReduceFromModelParallelRegion           | all-reduce     | identity       |
+| _ScatterToModelParallelRegion            | split last dim | all-gather     |
+| _GatherFromModelParallelRegion           | all-gather     | split last dim |
+| _ScatterToSequenceParallelRegion         | split dim 0    | all-gather     |
+| _GatherFromSequenceParallelRegion        | all-gather 0   | reduce-scatter |
+| _ReduceScatterToSequenceParallelRegion   | reduce-scatter | all-gather 0   |
+
+All functions run inside ``shard_map`` over the tp axis; the pairing is made
+explicit with ``custom_vjp`` so the backward collective is exactly the one
+Megatron specifies (not whatever transpose JAX would derive).  On TPU these
+lower to XLA all-reduce / all-gather / reduce-scatter over ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+
+__all__ = [
+    "copy_to_tensor_model_parallel_region",
+    "reduce_from_tensor_model_parallel_region",
+    "scatter_to_tensor_model_parallel_region",
+    "gather_from_tensor_model_parallel_region",
+    "scatter_to_sequence_parallel_region",
+    "gather_from_sequence_parallel_region",
+    "reduce_scatter_to_sequence_parallel_region",
+]
+
+
+def _axis(axis_name):
+    return TENSOR_PARALLEL_AXIS if axis_name is None else axis_name
+
+
+def _split_my_shard(x, dim, axis_name):
+    """Keep this rank's chunk of x along dim (mappings.py _split)."""
+    n = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    chunk = x.shape[dim] // n
+    return jax.lax.dynamic_slice_in_dim(x, rank * chunk, chunk, axis=dim)
+
+
+def _all_gather_dim(x, dim, axis_name):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce_scatter_dim(x, dim, axis_name):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim, tiled=True)
+
+
+# --- copy / reduce ---------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=None):
+    """Identity fwd / all-reduce bwd (the Megatron ``f``; mappings.py:141)."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (jax.lax.psum(g, _axis(axis_name)),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=None):
+    """All-reduce fwd / identity bwd (the Megatron ``g``; mappings.py:164)."""
+    return jax.lax.psum(x, _axis(axis_name))
+
+
+def _reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, _axis(axis_name)), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+# --- last-dim scatter/gather (model-parallel region) -----------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=None):
+    """Split last dim fwd / all-gather bwd (mappings.py:187)."""
+    return _split_my_shard(x, -1, _axis(axis_name))
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_my_shard(x, -1, _axis(axis_name)), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, g.ndim - 1, _axis(axis_name)),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=None):
+    """All-gather last dim fwd / split bwd (mappings.py:200)."""
+    return _all_gather_dim(x, x.ndim - 1, _axis(axis_name))
+
+
+def _gather_fwd(x, axis_name):
+    return _all_gather_dim(x, x.ndim - 1, _axis(axis_name)), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_my_shard(g, -1, _axis(axis_name)),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+# --- sequence-parallel (first-dim) region (mappings.py:213-301) ------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=None):
+    """Split dim 0 fwd / all-gather bwd (_ScatterToSequenceParallelRegion)."""
+    return _split_my_shard(x, 0, _axis(axis_name))
+
+
+def _sp_scatter_fwd(x, axis_name):
+    return _split_my_shard(x, 0, _axis(axis_name)), None
+
+
+def _sp_scatter_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, 0, _axis(axis_name)),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(x, axis_name=None,
+                                         tensor_parallel_output_grad=True):
+    """All-gather dim 0 fwd; bwd is reduce-scatter (when the consumer is a
+    tensor-parallel op producing partial grads) or plain split
+    (_GatherFromSequenceParallelRegion, mappings.py:296)."""
+    return _all_gather_dim(x, 0, _axis(axis_name))
+
+
+def _sp_gather_fwd(x, axis_name, tensor_parallel_output_grad):
+    return _all_gather_dim(x, 0, _axis(axis_name)), None
+
+
+def _sp_gather_bwd(axis_name, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_dim(g, 0, _axis(axis_name)),)
+    return (_split_my_shard(g, 0, _axis(axis_name)),)
+
+
+gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=None):
+    """Reduce-scatter dim 0 fwd / all-gather bwd
+    (_ReduceScatterToSequenceParallelRegion)."""
+    return _reduce_scatter_dim(x, 0, _axis(axis_name))
+
+
+def _sp_rs_fwd(x, axis_name):
+    return _reduce_scatter_dim(x, 0, _axis(axis_name)), None
+
+
+def _sp_rs_bwd(axis_name, _, g):
+    return (_all_gather_dim(g, 0, _axis(axis_name)),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)

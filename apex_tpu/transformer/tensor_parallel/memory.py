@@ -1,0 +1,51 @@
+"""Pre-allocated memory buffers (apex/transformer/tensor_parallel/memory.py:25-168).
+
+The reference's ``MemoryBuffer``/``RingMemBuffer`` exist because torch's
+caching allocator fragments under Megatron's allocation pattern; XLA owns TPU
+memory and donation/aliasing removes the need.  The classes are provided for
+API parity: ``MemoryBuffer`` hands out views of one flat array (useful for
+packed optimizer state), ``RingMemBuffer`` rotates over N of them.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class MemoryBuffer:
+    def __init__(self, name: str, numel: int, dtype):
+        self.name = name
+        self.numel = numel
+        self.dtype = dtype
+        self.data = jnp.zeros((numel,), dtype)
+        self._offset = 0
+
+    def reset(self):
+        self._offset = 0
+
+    def get(self, shape: Tuple[int, ...]):
+        """A view-sized slice of the flat buffer (memory.py:79-96)."""
+        size = int(np.prod(shape))
+        if self._offset + size > self.numel:
+            raise AssertionError("MemoryBuffer out of space")
+        out = self.data[self._offset:self._offset + size].reshape(shape)
+        self._offset += size
+        return out
+
+
+class RingMemBuffer:
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype):
+        self.num_buffers = num_buffers
+        self.buffers = [
+            MemoryBuffer(f"{name} {i}", numel, dtype) for i in range(num_buffers)
+        ]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        buf.reset()
+        return buf

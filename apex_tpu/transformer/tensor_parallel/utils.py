@@ -1,0 +1,45 @@
+"""TP utility helpers (apex/transformer/tensor_parallel/utils.py parity)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator != 0:
+        raise AssertionError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor, num_partitions: int):
+    """Split along the last dim into equal chunks (utils.py split_tensor...)."""
+    last = tensor.shape[-1]
+    chunk = divide(last, num_partitions)
+    return tuple(
+        jnp.take(tensor, jnp.arange(i * chunk, (i + 1) * chunk), axis=-1)
+        for i in range(num_partitions)
+    )
+
+
+class VocabUtility:
+    """Vocab-range bookkeeping for the vocab-parallel embedding/xent
+    (utils.py VocabUtility)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+            per_partition_vocab_size: int, rank, world_size: int) -> Tuple:
+        first = rank * per_partition_vocab_size
+        return first, first + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
+                                           world_size: int) -> Tuple:
+        per_partition = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per_partition, rank, world_size)

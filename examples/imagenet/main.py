@@ -10,7 +10,7 @@ Demonstrates the Phase-3 slice (SURVEY.md §7): ResNet-50 with
   replacing the reference's DDP bucket machinery),
 - optional dynamic loss scaling for fp16 parity.
 
-Runs on synthetic data by default (`--synthetic`), so it works anywhere:
+Trains on synthetic data, so it works anywhere:
 single TPU chip, TPU pod slice, or the 8-virtual-device CPU mesh used by the
 test-suite.  The reference's ``--prof`` NVTX window maps to
 ``jax.profiler.trace``.
@@ -103,7 +103,8 @@ def main():
     ap.add_argument("--num-classes", type=int, default=1000)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--lr", type=float, default=0.1)
-    ap.add_argument("--synthetic", action="store_true", default=True)
+    # This example trains on synthetic data only (the reference's main_amp.py
+    # folder-loading belongs to a data-pipeline library, out of scope here).
     ap.add_argument("--prof", action="store_true",
                     help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
     args = ap.parse_args()

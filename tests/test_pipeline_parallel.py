@@ -1,0 +1,198 @@
+"""Pipeline-parallel schedule tests on the 8-device CPU mesh.
+
+Mirrors tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py: the
+pipelined loss and grads must match a sequential single-device execution of
+the same stacked stages, for both the plain and interleaved schedules.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.pipeline_parallel import (
+    PipelineStageSpec,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+)
+
+HID = 8
+
+
+@pytest.fixture
+def pp4_mesh(devices):
+    mesh = parallel_state.initialize_model_parallel(1, 4, devices=devices[:4])
+    yield mesh
+    parallel_state.destroy_model_parallel()
+
+
+def _stage_fn(params, x):
+    # one "layer": linear + gelu (wire format preserved)
+    h = jnp.dot(x, params["w"], precision="highest") + params["b"]
+    return jax.nn.gelu(h)
+
+
+def _first_fn(params, mb):
+    return mb["x"]  # identity embedding: wire = input
+
+
+def _last_fn(params, y, mb):
+    return jnp.mean((y - mb["y"]) ** 2)
+
+
+SPEC = PipelineStageSpec(stage_fn=_stage_fn, first_fn=_first_fn, last_fn=_last_fn)
+
+
+def _make_stage_params(rng, n_stages, key=0):
+    return {
+        "w": jnp.asarray(rng.standard_normal((n_stages, HID, HID)) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((n_stages, HID)) * 0.1, jnp.float32),
+    }
+
+
+def _sequential_reference(stacked, batches):
+    """Run all stages sequentially per microbatch; mean loss + grads."""
+
+    def loss(stacked):
+        n_micro = batches["x"].shape[0]
+        total = 0.0
+        for m in range(n_micro):
+            x = batches["x"][m]
+            for s in range(stacked["w"].shape[0]):
+                x = _stage_fn({"w": stacked["w"][s], "b": stacked["b"][s]}, x)
+            total = total + jnp.mean((x - batches["y"][m]) ** 2)
+        return total / n_micro
+
+    return jax.value_and_grad(loss)(stacked)
+
+
+@pytest.mark.parametrize("n_micro", [4, 7])
+def test_pipeline_matches_sequential(pp4_mesh, rng, n_micro):
+    stacked = _make_stage_params(rng, 4)
+    batches = {
+        "x": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+        "y": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+    }
+    ref_loss, ref_grads = _sequential_reference(stacked, batches)
+
+    def run(stage_params, batches):
+        # the leading stage dim [4, ...] shards to [1, ...] per rank
+        p = jax.tree.map(lambda l: l[0], stage_params)
+        loss, grads = forward_backward_pipelining_without_interleaving(
+            SPEC, p, batches)
+        return loss, jax.tree.map(lambda l: l[None], grads)
+
+    loss, grads = shard_map(
+        run, mesh=pp4_mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+        out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+        check_vma=False,
+    )(stacked, batches)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["b"]), np.asarray(ref_grads["b"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_no_pipelining_matches_fullbatch(rng):
+    params = {"w": jnp.asarray(rng.standard_normal((HID, HID)) * 0.3, jnp.float32)}
+    batches = {
+        "x": jnp.asarray(rng.standard_normal((4, 2, HID)), jnp.float32),
+        "y": jnp.asarray(rng.standard_normal((4, 2, HID)), jnp.float32),
+    }
+
+    def loss_fn(p, mb):
+        return jnp.mean((jnp.tanh(mb["x"] @ p["w"]) - mb["y"]) ** 2)
+
+    loss, grads = forward_backward_no_pipelining(loss_fn, params, batches)
+    # reference: mean over microbatches; grads summed over microbatches
+    ref_losses = [loss_fn(params, jax.tree.map(lambda l: l[i], batches))
+                  for i in range(4)]
+    ref_grads = sum(
+        np.asarray(jax.grad(loss_fn)(params, jax.tree.map(lambda l: l[i], batches))["w"])
+        for i in range(4))
+    np.testing.assert_allclose(float(loss), float(np.mean(ref_losses)), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["w"]), ref_grads, rtol=1e-5, atol=1e-6)
+
+    loss_fwd, g = forward_backward_no_pipelining(loss_fn, params, batches,
+                                                 forward_only=True)
+    assert g is None
+    np.testing.assert_allclose(float(loss_fwd), float(loss), rtol=1e-6)
+
+
+def test_get_forward_backward_func():
+    assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+    assert (get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving)
+    assert (get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving)
+
+
+@pytest.mark.parametrize("n_micro", [4, 6])
+def test_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
+    """vpp=2 over pp=4: 8 global stages; parity vs sequential 8-layer run."""
+    vpp, pp = 2, 4
+    stacked = _make_stage_params(rng, vpp * pp)  # [8, ...] global stage order
+    batches = {
+        "x": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+        "y": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+    }
+    ref_loss, ref_grads = _sequential_reference(stacked, batches)
+
+    # rank r holds chunks [r, r+pp] → per-rank leaves [vpp, ...]; global
+    # stage v*pp + r maps to rank r chunk v, so reshape [vpp, pp, ...] and
+    # shard the *second* dim over pp.
+    per_rank = {
+        "w": stacked["w"].reshape(vpp, pp, HID, HID),
+        "b": stacked["b"].reshape(vpp, pp, HID),
+    }
+
+    def run(stage_params, batches):
+        # inside: leaves [vpp, 1, ...] → squeeze the pp dim
+        p = jax.tree.map(lambda l: l.squeeze(1), stage_params)
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            SPEC, p, batches, num_model_chunks=vpp)
+        return loss, jax.tree.map(lambda l: l[:, None], grads)
+
+    loss, grads = shard_map(
+        run, mesh=pp4_mesh,
+        in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
+        out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
+        check_vma=False,
+    )(per_rank, batches)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(grads["w"]).reshape(vpp * pp, HID, HID),
+        np.asarray(ref_grads["w"]), rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_forward_only(pp4_mesh, rng):
+    stacked = _make_stage_params(rng, 4)
+    batches = {
+        "x": jnp.asarray(rng.standard_normal((3, 2, HID)), jnp.float32),
+        "y": jnp.asarray(rng.standard_normal((3, 2, HID)), jnp.float32),
+    }
+    ref_loss, _ = _sequential_reference(stacked, batches)
+
+    def run(stage_params, batches):
+        p = jax.tree.map(lambda l: l[0], stage_params)
+        loss, _ = forward_backward_pipelining_without_interleaving(
+            SPEC, p, batches, forward_only=True)
+        return loss
+
+    loss = shard_map(
+        run, mesh=pp4_mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked, batches)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)

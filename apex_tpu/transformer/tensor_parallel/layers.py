@@ -39,11 +39,7 @@ import jax.numpy as jnp
 
 import flax.linen as nn
 
-from apex_tpu.transformer.parallel_state import (
-    TENSOR_PARALLEL_AXIS,
-    get_mesh,
-    model_parallel_is_initialized,
-)
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
@@ -61,18 +57,21 @@ __all__ = [
 ]
 
 
-def _tp_size(axis_name: str) -> int:
-    if model_parallel_is_initialized():
-        return get_mesh().shape[axis_name]
-    return 1
-
-
 def maybe_axis_index(axis_name: str):
     """axis_index if inside a mapped context over ``axis_name``, else None."""
     try:
         return jax.lax.axis_index(axis_name)
     except NameError:
         return None
+
+
+def _tp_size(axis_name: str) -> int:
+    """Static tp world size: the mapped axis size when inside shard_map over
+    ``axis_name``, else 1 (single-chip semantics, even when a global mesh
+    exists — binding, not mesh presence, decides)."""
+    if maybe_axis_index(axis_name) is None:
+        return 1
+    return int(jax.lax.axis_size(axis_name))
 
 
 def _shard_init(init_fn: Callable, axis_name: str) -> Callable:
@@ -208,7 +207,8 @@ class VocabParallelEmbedding(nn.Module):
 
     num_embeddings: int
     embedding_dim: int
-    init_method: Callable = nn.initializers.normal(stddev=1.0)
+    # Megatron's init_method_normal(0.02) default (arguments.py init-method-std)
+    init_method: Callable = nn.initializers.normal(stddev=0.02)
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 

@@ -43,6 +43,17 @@ def _axis(axis_name):
     return TENSOR_PARALLEL_AXIS if axis_name is None else axis_name
 
 
+def _bound(axis_name) -> bool:
+    """True when the tp axis is bound in the current trace (inside
+    shard_map/pmap).  Unbound = world-size-1 semantics: every mapping is the
+    identity, so single-chip code uses the same model unchanged."""
+    try:
+        jax.lax.axis_index(_axis(axis_name))
+        return True
+    except NameError:
+        return False
+
+
 def _split_my_shard(x, dim, axis_name):
     """Keep this rank's chunk of x along dim (mappings.py _split)."""
     n = jax.lax.psum(1, axis_name)
@@ -63,7 +74,7 @@ def _reduce_scatter_dim(x, dim, axis_name):
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def copy_to_tensor_model_parallel_region(x, axis_name=None):
+def _copy_impl(x, axis_name=None):
     """Identity fwd / all-reduce bwd (the Megatron ``f``; mappings.py:141)."""
     return x
 
@@ -76,11 +87,11 @@ def _copy_bwd(axis_name, _, g):
     return (jax.lax.psum(g, _axis(axis_name)),)
 
 
-copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+_copy_impl.defvjp(_copy_fwd, _copy_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_from_tensor_model_parallel_region(x, axis_name=None):
+def _reduce_impl(x, axis_name=None):
     """All-reduce fwd / identity bwd (the Megatron ``g``; mappings.py:164)."""
     return jax.lax.psum(x, _axis(axis_name))
 
@@ -93,14 +104,14 @@ def _reduce_bwd(axis_name, _, g):
     return (g,)
 
 
-reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+_reduce_impl.defvjp(_reduce_fwd, _reduce_bwd)
 
 
 # --- last-dim scatter/gather (model-parallel region) -----------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_tensor_model_parallel_region(x, axis_name=None):
+def _scatter_impl(x, axis_name=None):
     """Split last dim fwd / all-gather bwd (mappings.py:187)."""
     return _split_my_shard(x, -1, _axis(axis_name))
 
@@ -113,11 +124,11 @@ def _scatter_bwd(axis_name, _, g):
     return (_all_gather_dim(g, g.ndim - 1, _axis(axis_name)),)
 
 
-scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+_scatter_impl.defvjp(_scatter_fwd, _scatter_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def gather_from_tensor_model_parallel_region(x, axis_name=None):
+def _gather_impl(x, axis_name=None):
     """All-gather last dim fwd / split bwd (mappings.py:200)."""
     return _all_gather_dim(x, x.ndim - 1, _axis(axis_name))
 
@@ -130,14 +141,14 @@ def _gather_bwd(axis_name, _, g):
     return (_split_my_shard(g, -1, _axis(axis_name)),)
 
 
-gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+_gather_impl.defvjp(_gather_fwd, _gather_bwd)
 
 
 # --- sequence-parallel (first-dim) region (mappings.py:213-301) ------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def scatter_to_sequence_parallel_region(x, axis_name=None):
+def _sp_scatter_impl(x, axis_name=None):
     """Split dim 0 fwd / all-gather bwd (_ScatterToSequenceParallelRegion)."""
     return _split_my_shard(x, 0, _axis(axis_name))
 
@@ -150,11 +161,11 @@ def _sp_scatter_bwd(axis_name, _, g):
     return (_all_gather_dim(g, 0, _axis(axis_name)),)
 
 
-scatter_to_sequence_parallel_region.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
+_sp_scatter_impl.defvjp(_sp_scatter_fwd, _sp_scatter_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def gather_from_sequence_parallel_region(x, axis_name=None,
+def _sp_gather_impl(x, axis_name=None,
                                          tensor_parallel_output_grad=True):
     """All-gather dim 0 fwd; bwd is reduce-scatter (when the consumer is a
     tensor-parallel op producing partial grads) or plain split
@@ -172,11 +183,11 @@ def _sp_gather_bwd(axis_name, tensor_parallel_output_grad, _, g):
     return (_split_my_shard(g, 0, _axis(axis_name)),)
 
 
-gather_from_sequence_parallel_region.defvjp(_sp_gather_fwd, _sp_gather_bwd)
+_sp_gather_impl.defvjp(_sp_gather_fwd, _sp_gather_bwd)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
-def reduce_scatter_to_sequence_parallel_region(x, axis_name=None):
+def _sp_rs_impl(x, axis_name=None):
     """Reduce-scatter dim 0 fwd / all-gather bwd
     (_ReduceScatterToSequenceParallelRegion)."""
     return _reduce_scatter_dim(x, 0, _axis(axis_name))
@@ -190,4 +201,47 @@ def _sp_rs_bwd(axis_name, _, g):
     return (_all_gather_dim(g, 0, _axis(axis_name)),)
 
 
-reduce_scatter_to_sequence_parallel_region.defvjp(_sp_rs_fwd, _sp_rs_bwd)
+_sp_rs_impl.defvjp(_sp_rs_fwd, _sp_rs_bwd)
+
+
+# --- public wrappers: identity when the axis is unbound (world size 1) -----
+
+
+def copy_to_tensor_model_parallel_region(x, axis_name=None):
+    """Identity fwd / all-reduce bwd (the Megatron ``f``; mappings.py:141)."""
+    return _copy_impl(x, axis_name) if _bound(axis_name) else x
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name=None):
+    """All-reduce fwd / identity bwd (the Megatron ``g``; mappings.py:164)."""
+    return _reduce_impl(x, axis_name) if _bound(axis_name) else x
+
+
+def scatter_to_tensor_model_parallel_region(x, axis_name=None):
+    """Split last dim fwd / all-gather bwd (mappings.py:187)."""
+    return _scatter_impl(x, axis_name) if _bound(axis_name) else x
+
+
+def gather_from_tensor_model_parallel_region(x, axis_name=None):
+    """All-gather last dim fwd / split bwd (mappings.py:200)."""
+    return _gather_impl(x, axis_name) if _bound(axis_name) else x
+
+
+def scatter_to_sequence_parallel_region(x, axis_name=None):
+    """Split dim 0 fwd / all-gather bwd (_ScatterToSequenceParallelRegion)."""
+    return _sp_scatter_impl(x, axis_name) if _bound(axis_name) else x
+
+
+def gather_from_sequence_parallel_region(x, axis_name=None,
+                                         tensor_parallel_output_grad=True):
+    """All-gather dim 0 fwd; reduce-scatter (or split) bwd
+    (_GatherFromSequenceParallelRegion, mappings.py:296)."""
+    if not _bound(axis_name):
+        return x
+    return _sp_gather_impl(x, axis_name, tensor_parallel_output_grad)
+
+
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=None):
+    """Reduce-scatter dim 0 fwd / all-gather bwd
+    (_ReduceScatterToSequenceParallelRegion)."""
+    return _sp_rs_impl(x, axis_name) if _bound(axis_name) else x

@@ -1,0 +1,11 @@
+"""apex_tpu.transformer.testing — standalone models + test fixtures.
+
+Parity: apex/transformer/testing (standalone_{gpt,bert,transformer_lm},
+commons, global_vars, arguments, distributed_test_base — the last replaced by
+the CPU-mesh conftest pattern, SURVEY.md §4 "TPU translation").
+"""
+
+from apex_tpu.transformer.testing.standalone_bert import BertModel, bert_model_provider
+from apex_tpu.transformer.testing.standalone_gpt import GPTModel, gpt_model_provider
+
+__all__ = ["BertModel", "bert_model_provider", "GPTModel", "gpt_model_provider"]

@@ -1,0 +1,140 @@
+"""Test/bench fixtures: pipeline-ready GPT builder + step closures.
+
+Parity target: ``apex.transformer.testing.commons`` (commons.py:44-291) —
+toy models, fwd-step closures, and ``initialize_distributed`` helpers used by
+the reference's distributed tests.
+
+The centerpiece here is :func:`build_gpt_pipeline`: a GPT sliced for the SPMD
+pipeline schedules — embedding as the first-stage adapter, a block of
+``layers_per_stage`` parallel transformer layers as the repeated stage body,
+and final-LN + tied logits + vocab-parallel cross entropy as the last-stage
+head.  Composes tp (+sequence parallel) inside each stage with pp across
+stages and dp outside, which is exactly the 3D layout of
+``test_gpt_minimal.py`` / ``gpt_scaling_test.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.layers import FusedLayerNorm
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+)
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    Embedding,
+    ParallelTransformerLayer,
+    parallel_lm_logits,
+)
+from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
+
+__all__ = ["GPTPipeConfig", "build_gpt_pipeline", "init_gpt_pipeline_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTPipeConfig:
+    vocab_size: int = 128
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    layers_per_stage: int = 2
+    max_sequence_length: int = 64
+    sequence_parallel_enabled: bool = False
+    apply_rope: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+
+class _StageBlock(nn.Module):
+    cfg: GPTPipeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.cfg.layers_per_stage):
+            x = ParallelTransformerLayer(
+                self.cfg.hidden_size, self.cfg.num_attention_heads,
+                attn_mask_type=AttnMaskType.causal,
+                apply_rope=self.cfg.apply_rope,
+                sequence_parallel_enabled=self.cfg.sequence_parallel_enabled,
+                params_dtype=self.cfg.params_dtype,
+                axis_name=self.cfg.axis_name, name=f"layer_{i}")(x)
+        return x
+
+
+class _Head(nn.Module):
+    cfg: GPTPipeConfig
+
+    @nn.compact
+    def __call__(self, y, labels, word_embeddings):
+        y = FusedLayerNorm(
+            self.cfg.hidden_size,
+            sequence_parallel_enabled=self.cfg.sequence_parallel_enabled,
+            axis_name=self.cfg.axis_name, name="final_layernorm")(y)
+        logits = parallel_lm_logits(
+            y, word_embeddings.astype(y.dtype), self.cfg.axis_name,
+            sequence_parallel_enabled=self.cfg.sequence_parallel_enabled)
+        loss = vocab_parallel_cross_entropy(
+            logits.transpose(1, 0, 2), labels, axis_name=self.cfg.axis_name)
+        return loss.mean()
+
+
+def build_gpt_pipeline(cfg: GPTPipeConfig) -> PipelineStageSpec:
+    """A :class:`PipelineStageSpec` for the SPMD pipeline schedules.
+
+    Params pytree (per pp×tp rank):
+    ``{"embed": ..., "block": ..., "head": ...}`` — embed/head are used by
+    the first/last adapters (replicated across pp; their grads are the
+    masked contributions the reference syncs over the embedding group).
+    Microbatch pytree: ``{"ids": [b, s] int32, "labels": [b, s] int32}``.
+    """
+    embed = Embedding(cfg.hidden_size, cfg.vocab_size, cfg.max_sequence_length,
+                      use_position_embedding=not cfg.apply_rope,
+                      sequence_parallel_enabled=cfg.sequence_parallel_enabled,
+                      params_dtype=cfg.params_dtype, axis_name=cfg.axis_name)
+    block = _StageBlock(cfg)
+    head = _Head(cfg)
+
+    def first_fn(params, mb):
+        return embed.apply(params["embed"], mb["ids"])
+
+    def stage_fn(params, x):
+        return block.apply(params["block"], x)
+
+    def last_fn(params, y, mb):
+        word = params["embed"]["params"]["word_embeddings"]["embedding"]
+        return head.apply(params["head"], y, mb["labels"], word)
+
+    return PipelineStageSpec(stage_fn=stage_fn, first_fn=first_fn,
+                             last_fn=last_fn)
+
+
+def init_gpt_pipeline_params(cfg: GPTPipeConfig, key, sample_ids) -> Any:
+    """Init one pp-rank's params (call inside shard_map so tp/pp rank-folded
+    init draws the right shards; fold the pp rank for per-stage weights)."""
+    embed = Embedding(cfg.hidden_size, cfg.vocab_size, cfg.max_sequence_length,
+                      use_position_embedding=not cfg.apply_rope,
+                      sequence_parallel_enabled=cfg.sequence_parallel_enabled,
+                      params_dtype=cfg.params_dtype, axis_name=cfg.axis_name)
+    block = _StageBlock(cfg)
+    head = _Head(cfg)
+
+    from apex_tpu.transformer.tensor_parallel.layers import maybe_axis_index
+
+    pp_idx = maybe_axis_index("pp")
+    block_key = key if pp_idx is None else jax.random.fold_in(key, pp_idx)
+
+    embed_params = embed.init(jax.random.fold_in(key, 1), sample_ids)
+    wire = embed.apply(embed_params, sample_ids)
+    block_params = block.init(jax.random.fold_in(block_key, 2), wire)
+    wire2 = block.apply(block_params, wire)
+    word = embed_params["params"]["word_embeddings"]["embedding"]
+    labels = jnp.zeros(sample_ids.shape, jnp.int32)
+    head_params = head.init(jax.random.fold_in(key, 3), wire2, labels, word)
+    return {"embed": embed_params, "block": block_params, "head": head_params}

@@ -1,0 +1,101 @@
+"""Standalone BERT (apex/transformer/testing/standalone_bert.py parity).
+
+``BertModel``: padding-mask bidirectional TransformerLanguageModel with
+pooler, binary (NSP) head, and tied LM head — the ``test_bert_minimal.py``
+model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.layers import FusedLayerNorm
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    TransformerLanguageModel,
+    parallel_lm_logits,
+)
+
+__all__ = ["BertModel", "bert_model_provider"]
+
+
+class Pooler(nn.Module):
+    """tanh(dense(first token)) (standalone_bert Pooler)."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, hidden):  # [s, b, h]
+        first = hidden[0]
+        return jnp.tanh(nn.Dense(self.hidden_size)(first))
+
+
+class BertLMHead(nn.Module):
+    """LN + gelu dense + tied-embedding logits (standalone_bert LMHead)."""
+
+    hidden_size: int
+
+    @nn.compact
+    def __call__(self, hidden):
+        h = nn.Dense(self.hidden_size)(hidden)
+        h = nn.gelu(h, approximate=True)
+        return FusedLayerNorm(self.hidden_size, name="layernorm")(h)
+
+
+class BertModel(nn.Module):
+    num_layers: int = 2
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    vocab_size: int = 128
+    max_sequence_length: int = 64
+    add_binary_head: bool = True
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    def setup(self):
+        self.language_model = TransformerLanguageModel(
+            self.num_layers, self.hidden_size, self.num_attention_heads,
+            self.vocab_size, self.max_sequence_length,
+            attn_mask_type=AttnMaskType.padding,
+            params_dtype=self.params_dtype, axis_name=self.axis_name)
+        self.lm_head = BertLMHead(self.hidden_size)
+        if self.add_binary_head:
+            self.pooler = Pooler(self.hidden_size)
+            self.binary_head = nn.Dense(2)
+
+    def __call__(self, input_ids, attention_mask=None, lm_labels=None,
+                 deterministic: bool = True):
+        """attention_mask: [b, s] with 1 = keep (BERT convention)."""
+        mask4d = None
+        if attention_mask is not None:
+            keep = attention_mask.astype(jnp.bool_)
+            # [b,1,s,s]: mask out keys that are padding (True = mask out)
+            mask4d = jnp.logical_not(keep)[:, None, None, :]
+            mask4d = jnp.broadcast_to(
+                mask4d, (keep.shape[0], 1, keep.shape[1], keep.shape[1]))
+        hidden = self.language_model(input_ids, attention_mask=mask4d,
+                                     deterministic=deterministic)
+        lm_hidden = self.lm_head(hidden)
+        word_emb = self.language_model.variables["params"]["embedding"][
+            "word_embeddings"]["embedding"]
+        logits = parallel_lm_logits(lm_hidden, word_emb.astype(lm_hidden.dtype),
+                                    self.axis_name)
+        binary = self.binary_head(self.pooler(hidden)) if self.add_binary_head else None
+        if lm_labels is None:
+            return logits, binary
+        loss = vocab_parallel_cross_entropy(
+            logits.transpose(1, 0, 2), lm_labels, axis_name=self.axis_name)
+        return loss, binary
+
+
+def bert_model_provider(pre_process: bool = True, post_process: bool = True,
+                        **kwargs) -> BertModel:
+    del pre_process, post_process
+    return BertModel(**kwargs)

@@ -1,0 +1,75 @@
+"""Standalone GPT (apex/transformer/testing/standalone_gpt.py parity).
+
+``GPTModel``: causal TransformerLanguageModel with weight-tied LM head and
+vocab-parallel cross-entropy ``loss`` method — the model the reference's
+``test_gpt_minimal.py`` / ``gpt_scaling_test.py`` trains, and this repo's
+benchmark flagship.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.transformer.enums import AttnMaskType
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel import vocab_parallel_cross_entropy
+from apex_tpu.transformer.testing.standalone_transformer_lm import (
+    TransformerLanguageModel,
+    parallel_lm_logits,
+)
+
+__all__ = ["GPTModel", "gpt_model_provider"]
+
+
+class GPTModel(nn.Module):
+    num_layers: int = 2
+    hidden_size: int = 64
+    num_attention_heads: int = 4
+    vocab_size: int = 128
+    max_sequence_length: int = 64
+    apply_rope: bool = False
+    activations_checkpoint: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    def setup(self):
+        self.language_model = TransformerLanguageModel(
+            self.num_layers, self.hidden_size, self.num_attention_heads,
+            self.vocab_size, self.max_sequence_length,
+            attn_mask_type=AttnMaskType.causal,
+            apply_rope=self.apply_rope,
+            activations_checkpoint=self.activations_checkpoint,
+            sequence_parallel_enabled=self.sequence_parallel_enabled,
+            params_dtype=self.params_dtype, axis_name=self.axis_name)
+
+    def __call__(self, input_ids, labels=None, position_ids=None,
+                 deterministic: bool = True):
+        """Returns per-token loss [b, s] when labels given, else logits
+        [s, b, vocab/tp]."""
+        hidden = self.language_model(input_ids, position_ids,
+                                     deterministic=deterministic)
+        # weight tying: reuse the vocab-parallel embedding table
+        word_emb = self.language_model.variables["params"]["embedding"][
+            "word_embeddings"]["embedding"]
+        logits = parallel_lm_logits(
+            hidden, word_emb.astype(hidden.dtype), self.axis_name,
+            sequence_parallel_enabled=self.sequence_parallel_enabled)
+        if labels is None:
+            return logits
+        # logits [s, b, v/tp] → [b, s, v/tp]
+        logits = logits.transpose(1, 0, 2)
+        return vocab_parallel_cross_entropy(logits, labels,
+                                            axis_name=self.axis_name)
+
+
+def gpt_model_provider(pre_process: bool = True, post_process: bool = True,
+                       **kwargs) -> GPTModel:
+    """standalone_gpt.gpt_model_provider parity (pre/post flags accepted for
+    the virtual-pp ``build_model`` path)."""
+    del pre_process, post_process
+    return GPTModel(**kwargs)

@@ -1,0 +1,113 @@
+"""Benchmark: GPT training-step throughput on the available device(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The flagship config is a GPT-2-style causal LM trained with the full
+apex_tpu stack (fused LN/softmax kernels, FusedAdam, bf16 policy).  On a
+single chip the model is sized to fit; `vs_baseline` is the measured
+model-FLOPs utilization (MFU) against the chip's peak, normalized to the
+BASELINE.md north-star of 45% MFU (vs_baseline = MFU / 0.45, so 1.0 means
+the target is met).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# v5e: 197 TFLOP/s bf16 per chip; v5p: 459; v4: 275 (public specs)
+_PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0,
+                "v6": 918.0}
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in _PEAK_TFLOPS.items():
+        if k in kind:
+            return v
+    return 197.0  # assume v5e-class
+
+
+def main() -> None:
+    from apex_tpu.amp import get_policy
+    from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.transformer.testing import GPTModel
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # GPT-2 medium-ish sizing that fits one v5e chip in bf16
+        num_layers, hidden, heads, vocab, seq, batch = 12, 1024, 16, 50304, 1024, 8
+        steps, dtype = 20, jnp.bfloat16
+    else:  # CPU smoke sizing
+        num_layers, hidden, heads, vocab, seq, batch = 2, 128, 4, 1024, 128, 2
+        steps, dtype = 3, jnp.float32
+
+    policy = get_policy("O2" if on_tpu else "O0")
+    model = GPTModel(num_layers=num_layers, hidden_size=hidden,
+                     num_attention_heads=heads, vocab_size=vocab,
+                     max_sequence_length=seq, params_dtype=jnp.float32)
+    opt = FusedAdam(lr=1e-4, master_weights=on_tpu)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    params = model.init(jax.random.PRNGKey(0), ids)
+    params = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32
+                          and p.ndim >= 2 else p, params)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            return model.apply(p, ids, labels=labels).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = opt.step(grads, params, opt_state)
+        return new_params, new_state, loss
+
+    # warmup/compile
+    params, opt_state, loss = train_step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+
+    # model FLOPs: 6 * N_params * tokens (fwd+bwd), attention term included
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params)
+                   if hasattr(l, "shape"))
+    flops_per_token = 6 * n_params + 12 * num_layers * hidden * seq
+    tflops = tokens_per_sec * flops_per_token / 1e12
+    peak = _peak_tflops(dev)
+    mfu = tflops / peak if on_tpu else 0.0
+
+    result = {
+        "metric": "gpt2_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
+        "mfu": round(mfu, 4),
+        "model_tflops_per_sec": round(tflops, 2),
+        "device": str(dev.device_kind),
+        "config": {"layers": num_layers, "hidden": hidden, "heads": heads,
+                   "vocab": vocab, "seq": seq, "batch": batch,
+                   "loss": round(float(loss), 4)},
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,8 @@ import importlib as _importlib
 # as modules land (SURVEY.md §7 Phase 6).
 _SUBMODULES = (
     "clip_grad",
+    "fmha",
+    "multihead_attn",
 )
 
 

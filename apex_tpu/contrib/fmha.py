@@ -1,0 +1,70 @@
+"""Varlen packed attention (apex.contrib.fmha parity).
+
+Reference: ``apex/contrib/fmha/fmha.py:33-109`` — ``FMHAFun``/``FMHA`` run
+fused attention over a *packed* batch: qkv is ``[total_tokens, 3, h, d]``
+and ``cu_seqlens`` (``[b+1]`` cumulative sequence starts) delimits the
+sequences; kernels exist only for seq ≤ 512, head dim 64, fp16.
+
+TPU design: packing maps directly onto the flash-attention kernel's segment
+ids — token i belongs to sequence ``searchsorted(cu_seqlens, i)``, tokens
+attend only within their own segment, and no 512/d64/fp16 limits apply.
+The packed total length stays static under jit (cu_seqlens values may be
+traced), which is exactly the TPU-friendly formulation of varlen: one dense
+[1, h, total, d] problem instead of b ragged ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.ops.flash_attention import flash_attention
+
+__all__ = ["fmha_varlen", "FMHA"]
+
+
+def segment_ids_from_cu_seqlens(cu_seqlens, total: int):
+    """[b+1] cumulative starts → [total] int segment ids (1-based; positions
+    past cu_seqlens[-1] get id 0 = padding)."""
+    pos = jnp.arange(total)
+    seg = jnp.searchsorted(cu_seqlens, pos, side="right")
+    in_range = pos < cu_seqlens[-1]
+    return jnp.where(in_range, seg, 0).astype(jnp.int32)
+
+
+def fmha_varlen(qkv, cu_seqlens, *, causal: bool = False,
+                scale: Optional[float] = None):
+    """Packed varlen attention.
+
+    Args:
+      qkv: ``[total, 3, h, d]`` packed queries/keys/values (fmha layout).
+      cu_seqlens: ``[b+1]`` int32 cumulative sequence boundaries.
+    Returns ``[total, h, d]`` context.
+    """
+    total, three, h, d = qkv.shape
+    assert three == 3, "qkv must be packed as [total, 3, h, d]"
+    seg = segment_ids_from_cu_seqlens(cu_seqlens, total)[None]  # [1, total]
+    q = qkv[:, 0].transpose(1, 0, 2)[None]  # [1, h, total, d]
+    k = qkv[:, 1].transpose(1, 0, 2)[None]
+    v = qkv[:, 2].transpose(1, 0, 2)[None]
+    out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                          scale=scale)
+    return out[0].transpose(1, 0, 2)  # [total, h, d]
+
+
+class FMHA(nn.Module):
+    """Module parity with ``apex.contrib.fmha.FMHA``: packed-qkv attention
+    with the per-sequence boundaries supplied at call time.  Attention
+    dropout is not fused (reference saves the dropout mask in-kernel); apply
+    dropout on the returned context if needed."""
+
+    num_heads: int
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, qkv, cu_seqlens, max_s=None, is_training: bool = True):
+        del max_s, is_training  # static shapes: no per-call seq cap needed
+        return fmha_varlen(qkv, cu_seqlens, causal=self.causal)

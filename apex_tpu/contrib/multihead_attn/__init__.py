@@ -1,0 +1,295 @@
+"""Fused multi-head attention modules (apex.contrib.multihead_attn parity).
+
+Reference: ``apex/contrib/multihead_attn/__init__.py:1-3`` exports
+``SelfMultiheadAttn``, ``EncdecMultiheadAttn`` and
+``fast_mask_softmax_dropout_func``; the modules (self_multihead_attn.py,
+encdec_multihead_attn.py) are [time, batch, channel] attention blocks with
+±bias, ±residual "norm-add", binary or additive key-padding masks, and a
+CUTLASS-based fused attention core (~7k LoC of CUDA).
+
+TPU design: the fused core is :func:`apex_tpu.ops.flash_attention` — one
+Pallas online-softmax kernel replaces the reference's unfused QKV
+GEMM→softmax→dropout→GEMM chain *and* its fixed-sequence fmha tiles.  The
+projections stay as plain XLA matmuls (cublasLt epilogue fusion is XLA's job
+on TPU).  When attention dropout is active or the caller supplies an
+additive/time mask, the core routes through the materialized
+scaled-masked-softmax path (still fused by XLA) because those features need
+per-element probabilities; the flash path covers the
+deterministic/key-padding cases that dominate inference and bf16 training.
+
+The reference's ``impl='fast'|'default'`` knob is kept: ``fast`` uses the
+flash/fused route above, ``default`` always materializes (the reference's
+pure-PyTorch path).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.softmax import scaled_masked_softmax
+
+__all__ = [
+    "SelfMultiheadAttn",
+    "EncdecMultiheadAttn",
+    "fast_mask_softmax_dropout_func",
+]
+
+_MASK_VALUE = -10000.0
+
+
+def fast_mask_softmax_dropout_func(is_training, heads, inputs, pad_mask,
+                                   mask_additive, dropout_prob,
+                                   dropout_rng=None):
+    """softmax(+pad mask)(+dropout) on [b*h, sq, sk] scores.
+
+    Parity: ``mask_softmax_dropout_func.py`` — the standalone fused
+    softmax-dropout the reference exports.  ``pad_mask`` is [b, sk] with 1s
+    on padded keys (binary) or additive float values (``mask_additive``).
+    """
+    bh, sq, sk = inputs.shape
+    if pad_mask is None:
+        probs = scaled_masked_softmax(
+            inputs.reshape(bh, 1, sq, sk),
+            jnp.zeros((bh, 1, sq, sk), jnp.bool_)).reshape(bh, sq, sk)
+    elif mask_additive:
+        b = pad_mask.shape[0]
+        x = inputs.reshape(b, bh // b, sq, sk)
+        x = x + pad_mask[:, None, None, :].astype(x.dtype)
+        probs = scaled_masked_softmax(
+            x, jnp.zeros((b, 1, sq, sk), jnp.bool_)).reshape(bh, sq, sk)
+    else:
+        b = pad_mask.shape[0]
+        mask = jnp.broadcast_to(pad_mask[:, None, None, :].astype(jnp.bool_),
+                                (b, 1, sq, sk))
+        probs = scaled_masked_softmax(
+            inputs.reshape(b, bh // b, sq, sk), mask).reshape(bh, sq, sk)
+    if is_training and dropout_prob > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_prob,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_prob), 0.0)
+    return probs
+
+
+def _attention_core(q, k, v, *, key_padding_mask, attn_mask, mask_additive,
+                    scale, dropout, deterministic, dropout_rng, impl):
+    """[b, h, s, d] attention with the reference's mask conventions.
+
+    key_padding_mask: [b, sk], 1/True = pad (exclude).  attn_mask: [sq, sk]
+    time mask, 1/True = exclude.  Additive masks carry float values.
+    """
+    use_flash = (impl == "fast" and attn_mask is None and not mask_additive
+                 and (deterministic or dropout == 0.0))
+    if use_flash:
+        seg = None
+        if key_padding_mask is not None:
+            b, sk = key_padding_mask.shape
+            kseg = jnp.where(key_padding_mask.astype(jnp.bool_), 0, 1)
+            qseg = jnp.ones((b, q.shape[2]), jnp.int32)
+            seg = (qseg.astype(jnp.int32), kseg.astype(jnp.int32))
+        return flash_attention(q, k, v, segment_ids=seg, scale=scale)
+
+    scores = jax.lax.dot_general(
+        q.astype(jnp.float32) * scale, k.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1)))).astype(q.dtype)  # [b,h,sq,sk]
+    if attn_mask is not None:
+        scores = jnp.where(attn_mask.astype(jnp.bool_)[None, None],
+                           _MASK_VALUE, scores)
+    if key_padding_mask is not None:
+        if mask_additive:
+            scores = scores + key_padding_mask[:, None, None, :].astype(
+                scores.dtype)
+        else:
+            scores = jnp.where(
+                key_padding_mask.astype(jnp.bool_)[:, None, None, :],
+                _MASK_VALUE, scores)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if not deterministic and dropout > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+    return jax.lax.dot_general(
+        probs.astype(jnp.float32), v.astype(jnp.float32),
+        (((3,), (2,)), ((0, 1), (0, 1)))).astype(q.dtype)
+
+
+def _sbc_to_bhsd(x, heads):
+    """[s, b, h*d] → [b, h, s, d]."""
+    s, b, e = x.shape
+    return x.reshape(s, b, heads, e // heads).transpose(1, 2, 0, 3)
+
+
+def _bhsd_to_sbc(x):
+    b, h, s, d = x.shape
+    return x.transpose(2, 0, 1, 3).reshape(s, b, h * d)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Self multi-head attention, [time, batch, channel] layout.
+
+    Parity: ``apex/contrib/multihead_attn/self_multihead_attn.py`` —
+    ±bias, ±include_norm_add (pre-LN + residual add), binary or additive
+    key-padding mask, separate or packed QKV parameters.
+    """
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    separate_qkv_params: bool = False
+    mask_additive: bool = False
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key=None, value=None, key_padding_mask=None,
+                 attn_mask=None, is_training: bool = True):
+        del key, value  # self-attention: q == k == v (reference signature)
+        if self.mask_additive:
+            assert not self.include_norm_add, \
+                "additive mask not supported with layer norm"
+        e, h = self.embed_dim, self.num_heads
+        hd = e // h
+        scale = hd ** -0.5
+        x = query
+        residual = query
+        if self.include_norm_add:
+            gamma = self.param("lyr_nrm_gamma_weights", nn.initializers.ones,
+                               (e,), self.params_dtype)
+            beta = self.param("lyr_nrm_beta_weights", nn.initializers.zeros,
+                              (e,), self.params_dtype)
+            mean = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+            var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+            x = ((x - mean) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype)
+            x = x * gamma + beta
+
+        # xavier_uniform with gain sqrt(2) matches the reference's packed
+        # [3e, e] init (self_multihead_attn.py reset_parameters)
+        if self.separate_qkv_params:
+            def qkv_proj(name):
+                w = self.param(f"{name}_weight",
+                               nn.initializers.xavier_uniform(),
+                               (e, e), self.params_dtype)
+                y = x @ w.T.astype(x.dtype)
+                if self.bias:
+                    bb = self.param(f"{name}_bias", nn.initializers.zeros,
+                                    (e,), self.params_dtype)
+                    y = y + bb.astype(y.dtype)
+                return y
+            q, k, v = qkv_proj("q"), qkv_proj("k"), qkv_proj("v")
+        else:
+            w = self.param("in_proj_weight",
+                           nn.initializers.variance_scaling(
+                               2.0, "fan_avg", "uniform",
+                               in_axis=-1, out_axis=-2),
+                           (3 * e, e), self.params_dtype)
+            y = x @ w.T.astype(x.dtype)
+            if self.bias:
+                bb = self.param("in_proj_bias", nn.initializers.zeros,
+                                (3 * e,), self.params_dtype)
+                y = y + bb.astype(y.dtype)
+            q, k, v = jnp.split(y, 3, axis=-1)
+
+        rng = (self.make_rng("dropout")
+               if is_training and self.dropout > 0.0 else None)
+        ctx = _attention_core(
+            _sbc_to_bhsd(q, h), _sbc_to_bhsd(k, h), _sbc_to_bhsd(v, h),
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+            mask_additive=self.mask_additive, scale=scale,
+            dropout=self.dropout, deterministic=not is_training,
+            dropout_rng=rng, impl=self.impl)
+        ctx = _bhsd_to_sbc(ctx)
+
+        wo = self.param("out_proj_weight", nn.initializers.xavier_uniform(),
+                        (e, e), self.params_dtype)
+        out = ctx @ wo.T.astype(ctx.dtype)
+        if self.bias:
+            bo = self.param("out_proj_bias", nn.initializers.zeros,
+                            (e,), self.params_dtype)
+            out = out + bo.astype(out.dtype)
+        if self.include_norm_add:
+            if is_training and self.dropout > 0.0:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.dropout, out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = out + residual
+        return out
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Encoder-decoder attention: q from the decoder stream, k/v from the
+    encoder (``encdec_multihead_attn.py`` — in_proj_weight_q + packed
+    in_proj_weight_kv)."""
+
+    embed_dim: int
+    num_heads: int
+    dropout: float = 0.0
+    bias: bool = False
+    include_norm_add: bool = False
+    impl: str = "fast"
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, query, key, value=None, key_padding_mask=None,
+                 attn_mask=None, is_training: bool = True):
+        del value  # reference derives k and v from `key` via the packed proj
+        e, h = self.embed_dim, self.num_heads
+        scale = (e // h) ** -0.5
+        x = query
+        residual = query
+        if self.include_norm_add:
+            gamma = self.param("lyr_nrm_gamma_weights", nn.initializers.ones,
+                               (e,), self.params_dtype)
+            beta = self.param("lyr_nrm_beta_weights", nn.initializers.zeros,
+                              (e,), self.params_dtype)
+            mean = jnp.mean(x.astype(jnp.float32), axis=-1, keepdims=True)
+            var = jnp.var(x.astype(jnp.float32), axis=-1, keepdims=True)
+            x = ((x - mean) * jax.lax.rsqrt(var + 1e-5)).astype(x.dtype)
+            x = x * gamma + beta
+
+        wq = self.param("in_proj_weight_q", nn.initializers.xavier_uniform(),
+                        (e, e), self.params_dtype)
+        wkv = self.param("in_proj_weight_kv",
+                         nn.initializers.variance_scaling(
+                             2.0 ** 0.5, "fan_avg", "uniform",
+                             in_axis=-1, out_axis=-2),
+                         (2 * e, e), self.params_dtype)
+        q = x @ wq.T.astype(x.dtype)
+        kv = key @ wkv.T.astype(key.dtype)
+        if self.bias:
+            bq = self.param("in_proj_bias_q", nn.initializers.zeros,
+                            (e,), self.params_dtype)
+            bkv = self.param("in_proj_bias_kv", nn.initializers.zeros,
+                             (2 * e,), self.params_dtype)
+            q = q + bq.astype(q.dtype)
+            kv = kv + bkv.astype(kv.dtype)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        rng = (self.make_rng("dropout")
+               if is_training and self.dropout > 0.0 else None)
+        ctx = _attention_core(
+            _sbc_to_bhsd(q, h), _sbc_to_bhsd(k, h), _sbc_to_bhsd(v, h),
+            key_padding_mask=key_padding_mask, attn_mask=attn_mask,
+            mask_additive=False, scale=scale, dropout=self.dropout,
+            deterministic=not is_training, dropout_rng=rng, impl=self.impl)
+        ctx = _bhsd_to_sbc(ctx)
+
+        wo = self.param("out_proj_weight", nn.initializers.xavier_uniform(),
+                        (e, e), self.params_dtype)
+        out = ctx @ wo.T.astype(ctx.dtype)
+        if self.bias:
+            bo = self.param("out_proj_bias", nn.initializers.zeros,
+                            (e,), self.params_dtype)
+            out = out + bo.astype(out.dtype)
+        if self.include_norm_add:
+            if is_training and self.dropout > 0.0:
+                keep = jax.random.bernoulli(
+                    self.make_rng("dropout"), 1.0 - self.dropout, out.shape)
+                out = jnp.where(keep, out / (1.0 - self.dropout), 0.0)
+            out = out + residual
+        return out

@@ -1,0 +1,460 @@
+"""Flash attention — Pallas TPU kernels + jnp fallback.
+
+Parity targets (SURVEY.md §2.2): the ``fmhalib`` fused attention extension
+(apex/contrib/csrc/fmha/, fixed seq {128,256,384,512}, head dim 64, fp16
+tile kernels) and the attention core of ``fast_multihead_attn``
+(apex/contrib/csrc/multihead_attn/, CUTLASS batched GEMM + fused
+softmax).  Per the SURVEY design map, one Pallas flash-attention kernel with
+online softmax supersedes both: it handles arbitrary sequence lengths
+(no 512 cap), causal masking, and varlen packing via segment ids, and never
+materializes the [b, h, sq, sk] score matrix.
+
+Design (TPU-first, not a translation):
+
+- Grid ``(b*h, num_q_blocks, num_k_blocks)`` with the k axis innermost.
+  Scratch accumulators (running max ``m``, running sum ``l``, output
+  accumulator) persist across the sequential k steps of one q block —
+  the canonical TPU online-softmax layout.  Block sizes default to 128
+  (MXU-shaped); both matmuls per step hit the MXU in fp32 accumulation.
+- Causal masking is generated from iota (never loaded); whole k blocks
+  strictly above the diagonal are skipped with ``pl.when``.
+- Varlen ("THD"/packed) sequences use segment ids: query i attends to key j
+  iff ``q_seg[i] == kv_seg[j]``.  A padding mask is the special case of
+  giving pad positions segment id 0 and real tokens id 1.
+- Backward recomputes attention probabilities blockwise from the saved
+  logsumexp (no O(s^2) residual): a dq kernel (k innermost) and a dk/dv
+  kernel (q innermost), plus a cheap jnp precompute of
+  ``delta = rowsum(do * o)``.
+- Fully-masked query rows produce zeros, matching the fused-softmax
+  extensions' convention (and their gradient is exactly zero).
+
+The jnp fallback implements identical semantics for unsupported
+shapes/backends and is what the parity tests diff against.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._dispatch import kernels_enabled, use_interpret
+
+_NEG_INF = -1e30
+_DEFAULT_BLOCK = 128
+
+
+# ---------------------------------------------------------------------------
+# jnp reference path (also the fallback — fully differentiable)
+# ---------------------------------------------------------------------------
+
+
+def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
+                  kv_segment_ids=None, scale=None):
+    """Materialized attention with flash-identical masking semantics.
+
+    q: [b, h, sq, d]; k/v: [b, h, sk, d]; segment ids: [b, s]."""
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else scale
+    s = jax.lax.dot_general(
+        q.astype(jnp.float32) * scale, k.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1))))  # [b, h, sq, sk]
+    valid = None
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        valid = (col <= row + (sk - sq))[None, None]
+    if q_segment_ids is not None:
+        seg = (q_segment_ids[:, None, :, None] ==
+               kv_segment_ids[:, None, None, :])
+        valid = seg if valid is None else jnp.logical_and(valid, seg)
+    if valid is not None:
+        s = jnp.where(valid, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / l
+    if valid is not None:
+        any_valid = jnp.any(valid, axis=-1, keepdims=True)
+        p = jnp.where(any_valid, p, 0.0)
+    out = jax.lax.dot_general(
+        p, v.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))))
+    return out.astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward
+# ---------------------------------------------------------------------------
+
+
+def _block_mask(i, j, bq, bk, sq, sk, causal, has_seg, qseg, kseg):
+    """(bq, bk) bool validity for q block i vs k block j; None if all-valid."""
+    valid = None
+    if causal:
+        row = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        valid = col <= row + (sk - sq)
+    if has_seg:
+        # segment refs are lane-tiled (rows, 128); column 0 holds the ids
+        seg = qseg[:, :1] == kseg[:, :1].reshape(1, bk)
+        valid = seg if valid is None else jnp.logical_and(valid, seg)
+    return valid
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, has_seg, sq, sk):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Whole block strictly above the causal diagonal → nothing to do.
+    live = (j * bk <= i * bq + bq - 1 + (sk - sq)) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
+                            qseg_ref[0] if has_seg else None,
+                            kseg_ref[0] if has_seg else None)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), m_prev)
+        # exp(-inf - -inf) is nan; a still-empty row keeps correction 1
+        corr = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_cur))
+        corr = jnp.where(m_cur == -jnp.inf, 1.0, corr)
+        p = jnp.exp(jnp.where(m_cur == -jnp.inf, 0.0, s - m_cur))
+        # rows whose every element is masked contribute nothing
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
+        l_cur = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * corr + pv
+        m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_cur, l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        m = m_scr[:, :1]
+        # fully-masked rows (l == 0) emit zeros; lse=+inf makes their
+        # backward recomputed p exactly 0 as well
+        o = jnp.where(l > 0, acc_scr[...] / jnp.where(l > 0, l, 1.0), 0.0)
+        o_ref[0] = o.astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m + jnp.log(jnp.where(l > 0, l, 1.0)),
+                        jnp.inf)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _seg_specs(b, h, bq, bk, has_seg):
+    """Block specs for [b, s]-shaped segment-id inputs (dummy if absent)."""
+    if has_seg:
+        qspec = pl.BlockSpec((1, bq, 128), lambda g, i, j: (g // h, i, 0))
+        kspec = pl.BlockSpec((1, bk, 128), lambda g, i, j: (g // h, j, 0))
+    else:
+        qspec = pl.BlockSpec((1, 1, 128), lambda g, i, j: (0, 0, 0))
+        kspec = pl.BlockSpec((1, 1, 128), lambda g, i, j: (0, 0, 0))
+    return qspec, kspec
+
+
+def _expand_seg(seg):
+    """[b, s] → [b, s, 128] so segment ids tile cleanly in VMEM."""
+    return jnp.broadcast_to(seg[:, :, None], (*seg.shape, 128))
+
+
+def _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    has_seg = qseg is not None
+    grid = (b * h, sq // bq, sk // bk)
+    qseg3 = _expand_seg(qseg) if has_seg else jnp.zeros((1, 1, 128), jnp.int32)
+    kseg3 = _expand_seg(kseg) if has_seg else jnp.zeros((1, 1, 128), jnp.int32)
+    sqspec, skspec = _seg_specs(b, h, bq, bk, has_seg)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          has_seg=has_seg, sq=sq, sk=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            sqspec, skspec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+      v.reshape(b * h, sk, d), qseg3, kseg3)
+    return (o.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq))
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               qseg_ref, kseg_ref, dq_ref, dq_scr,
+               *, scale, causal, has_seg, sq, sk):
+    i, j = pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (j * bk <= i * bq + bq - 1 + (sk - sq)) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
+                            qseg_ref[0] if has_seg else None,
+                            kseg_ref[0] if has_seg else None)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG_INF)
+        lse = lse_ref[0][:, :1]
+        p = jnp.exp(s - lse)  # lse=+inf on dead rows → p = 0
+        do = do_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta)
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale, causal, has_seg, sq, sk):
+    j, i = pl.program_id(1), pl.program_id(2)  # k block outer, q block inner
+    ni = pl.num_programs(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    live = (j * bk <= i * bq + bq - 1 + (sk - sq)) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
+                            qseg_ref[0] if has_seg else None,
+                            kseg_ref[0] if has_seg else None)
+        if valid is not None:
+            s = jnp.where(valid, s, _NEG_INF)
+        lse = lse_ref[0][:, :1]
+        p = jnp.exp(s - lse)
+        do = do_ref[0].astype(jnp.float32)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        delta = delta_ref[0][:, :1]
+        ds = p * (dp - delta)
+        # q was pre-scaled, so ds·q already carries one factor of scale —
+        # dk = dsᵀ (q·scale) is exactly the chain-rule result
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
+                block_q, block_k):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    has_seg = qseg is not None
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    # [b*h, s, 128] lane-tiled copies of the per-row scalars
+    lse3 = jnp.broadcast_to(lse.reshape(b * h, sq)[:, :, None],
+                            (b * h, sq, 128))
+    delta3 = jnp.broadcast_to(delta.reshape(b * h, sq)[:, :, None],
+                              (b * h, sq, 128))
+    qseg3 = _expand_seg(qseg) if has_seg else jnp.zeros((1, 1, 128), jnp.int32)
+    kseg3 = _expand_seg(kseg) if has_seg else jnp.zeros((1, 1, 128), jnp.int32)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    do3 = do.reshape(b * h, sq, d)
+
+    sqspec, skspec = _seg_specs(b, h, bq, bk, has_seg)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          has_seg=has_seg, sq=sq, sk=sk),
+        grid=(b * h, sq // bq, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda g, i, j: (g, i, 0)),
+            sqspec, skspec,
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=use_interpret(),
+    )(q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
+
+    sqspec2, skspec2 = _seg_specs(b, h, bq, bk, has_seg)
+    # swap index maps: grid is (bh, k block, q block)
+    if has_seg:
+        sqspec2 = pl.BlockSpec((1, bq, 128), lambda g, j, i: (g // h, i, 0))
+        skspec2 = pl.BlockSpec((1, bk, 128), lambda g, j, i: (g // h, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          has_seg=has_seg, sq=sq, sk=sk),
+        grid=(b * h, sk // bk, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda g, j, i: (g, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda g, j, i: (g, i, 0)),
+            sqspec2, skspec2,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        interpret=use_interpret(),
+    )(q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp + dispatch
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
+    o, _ = _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
+    o, lse = _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
+    return o, (q, k, v, o, lse, qseg, kseg)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse, qseg, kseg = res
+    dq, dk, dv = _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
+                             block_q, block_k)
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _kernel_ok(q, k, block_q, block_k) -> bool:
+    if not kernels_enabled():
+        return False
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = min(block_q, sq), min(block_k, sk)
+    return (d % 64 == 0 and sq % bq == 0 and sk % bk == 0
+            and bq % 8 == 0 and bk % 8 == 0)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    segment_ids=None,
+                    scale: Optional[float] = None,
+                    block_q: int = _DEFAULT_BLOCK,
+                    block_k: int = _DEFAULT_BLOCK):
+    """Fused attention: softmax(q kᵀ · scale [+ masks]) v, never materializing
+    the score matrix.
+
+    Args:
+      q: ``[b, h, sq, d]``; k, v: ``[b, h, sk, d]``.
+      causal: apply a causal mask (aligned to the *last* query for sq < sk).
+      segment_ids: ``None``, a single ``[b, s]`` int array (self-attention),
+        or a ``(q_segment_ids, kv_segment_ids)`` pair.  Tokens attend only
+        within their own segment — this is the varlen/"THD" packing story
+        (reference fmha `fmha.py:33-109`) and also expresses padding masks.
+      scale: logit scale; defaults to ``1/sqrt(d)``.
+      block_q / block_k: kernel tile sizes (clamped to the sequence length).
+
+    Returns ``[b, h, sq, d]`` in q's dtype.  Fully-masked rows give zeros.
+    """
+    if segment_ids is None:
+        qseg = kseg = None
+    elif isinstance(segment_ids, tuple):
+        qseg, kseg = segment_ids
+    else:
+        qseg = kseg = segment_ids
+    d = q.shape[-1]
+    scale = (1.0 / d ** 0.5) if scale is None else float(scale)
+    if _kernel_ok(q, k, block_q, block_k):
+        return _flash(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
+    return mha_reference(q, k, v, causal=causal, q_segment_ids=qseg,
+                         kv_segment_ids=kseg, scale=scale)

@@ -217,6 +217,18 @@ def scaled_upper_triang_masked_softmax(x, scale: float = 1.0):
     return _softmax(x, None, float(scale), True)
 
 
+def scaled_causal_masked_softmax(x, mask, scale: float = 1.0):
+    """Causal triangle AND an explicit [b, 1, sq, sk] padding mask.
+
+    The reference's upper-triang kernel asserts the mask is None; its
+    dispatcher therefore can never combine the two.  TPU-side both are just
+    predicates on the same VMEM tile, so the combined path exists and the
+    dispatcher (transformer.functional.FusedScaleMaskSoftmax) uses it instead
+    of silently dropping the triangle.
+    """
+    return _softmax(x, mask.astype(jnp.bool_), float(scale), True)
+
+
 def generic_scaled_masked_softmax(x, mask, scale: float = 1.0):
     """Arbitrary-size fallback (``generic_scaled_masked_softmax_cuda``)."""
     return _jnp_custom(x, mask, float(scale))

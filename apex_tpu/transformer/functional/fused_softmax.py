@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from apex_tpu.ops.softmax import (
     _MAX_SK,
     generic_scaled_masked_softmax,
+    scaled_causal_masked_softmax,
     scaled_masked_softmax,
     scaled_softmax,
     scaled_upper_triang_masked_softmax,
@@ -81,9 +82,10 @@ class FusedScaleMaskSoftmax:
         scale = self.scale if self.scale is not None else 1.0
         if self.attn_mask_type == AttnMaskType.causal:
             if mask is not None:
-                # the causal kernel ignores an explicit mask (the reference
-                # asserts mask is None for the upper-triang path)
-                return scaled_masked_softmax(inputs, mask, scale)
+                # the reference's upper-triang kernel asserts mask is None;
+                # here causal + padding mask compose (a caller passing a
+                # padding-only mask still gets causal attention)
+                return scaled_causal_masked_softmax(inputs, mask, scale)
             return scaled_upper_triang_masked_softmax(inputs, scale)
         if mask is not None:
             if self.is_kernel_available(mask, b, np_, sq, sk):

@@ -56,6 +56,7 @@ class BertModel(nn.Module):
     vocab_size: int = 128
     max_sequence_length: int = 64
     add_binary_head: bool = True
+    use_flash_attention: bool = True
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -64,6 +65,7 @@ class BertModel(nn.Module):
             self.num_layers, self.hidden_size, self.num_attention_heads,
             self.vocab_size, self.max_sequence_length,
             attn_mask_type=AttnMaskType.padding,
+            use_flash_attention=self.use_flash_attention,
             params_dtype=self.params_dtype, axis_name=self.axis_name)
         self.lm_head = BertLMHead(self.hidden_size)
         if self.add_binary_head:
@@ -74,14 +76,19 @@ class BertModel(nn.Module):
                  deterministic: bool = True):
         """attention_mask: [b, s] with 1 = keep (BERT convention)."""
         mask4d = None
+        segment_ids = None
         if attention_mask is not None:
             keep = attention_mask.astype(jnp.bool_)
             # [b,1,s,s]: mask out keys that are padding (True = mask out)
             mask4d = jnp.logical_not(keep)[:, None, None, :]
             mask4d = jnp.broadcast_to(
                 mask4d, (keep.shape[0], 1, keep.shape[1], keep.shape[1]))
+            # flash path: pads = segment 0, kept = segment 1 (same kept-token
+            # outputs as the 4-D mask; pad-position outputs are don't-cares)
+            segment_ids = keep.astype(jnp.int32)
         hidden = self.language_model(input_ids, attention_mask=mask4d,
-                                     deterministic=deterministic)
+                                     deterministic=deterministic,
+                                     segment_ids=segment_ids)
         lm_hidden = self.lm_head(hidden)
         word_emb = self.language_model.variables["params"]["embedding"][
             "word_embeddings"]["embedding"]

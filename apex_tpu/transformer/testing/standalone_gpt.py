@@ -32,6 +32,7 @@ class GPTModel(nn.Module):
     vocab_size: int = 128
     max_sequence_length: int = 64
     apply_rope: bool = False
+    use_flash_attention: bool = True
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
@@ -43,6 +44,7 @@ class GPTModel(nn.Module):
             self.vocab_size, self.max_sequence_length,
             attn_mask_type=AttnMaskType.causal,
             apply_rope=self.apply_rope,
+            use_flash_attention=self.use_flash_attention,
             activations_checkpoint=self.activations_checkpoint,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name)

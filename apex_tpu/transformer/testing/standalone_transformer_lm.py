@@ -38,6 +38,7 @@ from apex_tpu.transformer.tensor_parallel import (
     vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.tensor_parallel.layers import _tp_size
+from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
 
 __all__ = [
@@ -79,19 +80,28 @@ class ParallelMLP(nn.Module):
 
 
 class ParallelAttention(nn.Module):
-    """Multi-head self-attention with tp-sharded heads (ParallelAttention)."""
+    """Multi-head self-attention with tp-sharded heads (ParallelAttention).
+
+    The attention core defaults to the Pallas flash kernel
+    (:func:`apex_tpu.ops.flash_attention`): causal masks and segment-id
+    padding/varlen masks never materialize the [b, np, s, s] score matrix.
+    Explicit 4-D ``attention_mask`` tensors and active attention dropout
+    take the materialized ``FusedScaleMaskSoftmax`` path (the reference's
+    fused-softmax dispatcher semantics)."""
 
     hidden_size: int
     num_attention_heads: int
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     attention_dropout: float = 0.0
     apply_rope: bool = False
+    use_flash_attention: bool = True
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 segment_ids=None):
         # x: [s, b, h]
         world = _tp_size(self.axis_name)
         np_local = self.num_attention_heads // world
@@ -115,23 +125,37 @@ class ParallelAttention(nn.Module):
         qt = q.transpose(1, 2, 0, 3)
         kt = k.transpose(1, 2, 0, 3)
         vt = v.transpose(1, 2, 0, 3)
-        scores = jax.lax.dot_general(
-            qt, kt, (((3,), (3,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32).astype(qt.dtype)  # [b,np,s,s]
+        scale = 1.0 / float(hd) ** 0.5
 
-        softmax = FusedScaleMaskSoftmax(
-            input_in_bf16=(qt.dtype == jnp.bfloat16),
-            input_in_fp16=(qt.dtype == jnp.float16),
-            attn_mask_type=self.attn_mask_type,
-            scale=1.0 / float(hd) ** 0.5)
-        probs = softmax(scores, attention_mask)
-        if self.attention_dropout > 0.0 and not deterministic:
-            probs = nn.Dropout(self.attention_dropout)(
-                probs, deterministic=False)
+        causal = self.attn_mask_type == AttnMaskType.causal
+        # segment ids express padding/varlen without a 4-D mask tensor; when
+        # a caller supplies both (BERT), the flash path uses the segments and
+        # the materialized fallback uses the mask — same kept-token outputs.
+        use_flash = (self.use_flash_attention
+                     and (segment_ids is not None
+                          or (causal and attention_mask is None))
+                     and (deterministic or self.attention_dropout == 0.0))
+        if use_flash:
+            ctx = flash_attention(qt, kt, vt, causal=causal,
+                                  segment_ids=segment_ids, scale=scale)
+        else:
+            scores = jax.lax.dot_general(
+                qt, kt, (((3,), (3,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32).astype(qt.dtype)
 
-        ctx = jax.lax.dot_general(
-            probs, vt, (((3,), (2,)), ((0, 1), (0, 1))),
-            preferred_element_type=jnp.float32).astype(vt.dtype)  # [b,np,s,hd]
+            softmax = FusedScaleMaskSoftmax(
+                input_in_bf16=(qt.dtype == jnp.bfloat16),
+                input_in_fp16=(qt.dtype == jnp.float16),
+                attn_mask_type=self.attn_mask_type,
+                scale=scale)
+            probs = softmax(scores, attention_mask)
+            if self.attention_dropout > 0.0 and not deterministic:
+                probs = nn.Dropout(self.attention_dropout)(
+                    probs, deterministic=False)
+
+            ctx = jax.lax.dot_general(
+                probs, vt, (((3,), (2,)), ((0, 1), (0, 1))),
+                preferred_element_type=jnp.float32).astype(vt.dtype)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, np_local * hd)
 
         out = RowParallelLinear(
@@ -157,12 +181,14 @@ class ParallelTransformerLayer(nn.Module):
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     hidden_dropout: float = 0.0
     apply_rope: bool = False
+    use_flash_attention: bool = True
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 segment_ids=None):
         ln1 = FusedLayerNorm(
             self.hidden_size,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
@@ -170,9 +196,11 @@ class ParallelTransformerLayer(nn.Module):
         attn = ParallelAttention(
             self.hidden_size, self.num_attention_heads,
             attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
+            use_flash_attention=self.use_flash_attention,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
-            name="self_attention")(ln1, attention_mask, deterministic)
+            name="self_attention")(ln1, attention_mask, deterministic,
+                                   segment_ids)
         if self.hidden_dropout > 0.0 and not deterministic:
             attn = nn.Dropout(self.hidden_dropout)(attn, deterministic=False)
         x = x + attn
@@ -198,6 +226,7 @@ class ParallelTransformer(nn.Module):
     num_attention_heads: int
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     apply_rope: bool = False
+    use_flash_attention: bool = True
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
@@ -205,7 +234,8 @@ class ParallelTransformer(nn.Module):
     final_layernorm: bool = True
 
     @nn.compact
-    def __call__(self, x, attention_mask=None, deterministic: bool = True):
+    def __call__(self, x, attention_mask=None, deterministic: bool = True,
+                 segment_ids=None):
         # tensor_parallel.random.CheckpointFunction semantics: recompute each
         # layer in backward when activations_checkpoint is set
         layer_cls = (nn.remat(ParallelTransformerLayer, static_argnums=(3,))
@@ -214,10 +244,11 @@ class ParallelTransformer(nn.Module):
             layer = layer_cls(
                 self.hidden_size, self.num_attention_heads,
                 attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
+                use_flash_attention=self.use_flash_attention,
                 sequence_parallel_enabled=self.sequence_parallel_enabled,
                 params_dtype=self.params_dtype, axis_name=self.axis_name,
                 name=f"layer_{i}")
-            x = layer(x, attention_mask, deterministic)
+            x = layer(x, attention_mask, deterministic, segment_ids)
         if self.final_layernorm:
             x = FusedLayerNorm(
                 self.hidden_size,
@@ -290,6 +321,7 @@ class TransformerLanguageModel(nn.Module):
     max_sequence_length: int
     attn_mask_type: AttnMaskType = AttnMaskType.causal
     apply_rope: bool = False
+    use_flash_attention: bool = True
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
@@ -297,7 +329,7 @@ class TransformerLanguageModel(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, position_ids=None, attention_mask=None,
-                 deterministic: bool = True):
+                 deterministic: bool = True, segment_ids=None):
         x = Embedding(
             self.hidden_size, self.vocab_size, self.max_sequence_length,
             use_position_embedding=not self.apply_rope,
@@ -307,8 +339,9 @@ class TransformerLanguageModel(nn.Module):
         x = ParallelTransformer(
             self.num_layers, self.hidden_size, self.num_attention_heads,
             attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
+            use_flash_attention=self.use_flash_attention,
             activations_checkpoint=self.activations_checkpoint,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
-            name="transformer")(x, attention_mask, deterministic)
+            name="transformer")(x, attention_mask, deterministic, segment_ids)
         return x

@@ -1,0 +1,179 @@
+"""contrib.multihead_attn + fmha tests (mirrors
+apex/contrib/test/multihead_attn/ and test/fmha numeric-parity style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.fmha import FMHA, fmha_varlen, segment_ids_from_cu_seqlens
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+    fast_mask_softmax_dropout_func,
+)
+from apex_tpu.ops.flash_attention import mha_reference
+
+
+def _ref_attention(x, params, heads, key_padding_mask=None):
+    """Dense [s,b,e] self-attention computed the long way for parity."""
+    e = x.shape[-1]
+    w = params["in_proj_weight"]
+    y = x @ w.T
+    q, k, v = jnp.split(y, 3, axis=-1)
+    s, b = x.shape[0], x.shape[1]
+    hd = e // heads
+
+    def to_bhsd(t):
+        return t.reshape(s, b, heads, hd).transpose(1, 2, 0, 3)
+
+    seg = None
+    if key_padding_mask is not None:
+        kseg = jnp.where(key_padding_mask.astype(bool), 0, 1).astype(jnp.int32)
+        qseg = jnp.ones((b, s), jnp.int32)
+        seg = (qseg, kseg)
+    ctx = mha_reference(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                        q_segment_ids=seg[0] if seg else None,
+                        kv_segment_ids=seg[1] if seg else None)
+    ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, e)
+    return ctx @ params["out_proj_weight"].T
+
+
+def test_self_attn_fast_matches_default(rng):
+    s, b, e, h = 16, 2, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    fast = SelfMultiheadAttn(e, h, impl="fast")
+    default = SelfMultiheadAttn(e, h, impl="default")
+    params = fast.init(jax.random.PRNGKey(0), x)
+    out_fast = fast.apply(params, x, is_training=False)
+    out_default = default.apply(params, x, is_training=False)
+    np.testing.assert_allclose(np.asarray(out_fast), np.asarray(out_default),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_self_attn_matches_manual_reference(rng):
+    s, b, e, h = 16, 2, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    m = SelfMultiheadAttn(e, h, impl="fast")
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x, is_training=False)
+    ref = _ref_attention(x, params["params"], h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_self_attn_key_padding_mask(rng):
+    s, b, e, h = 16, 2, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    pad = jnp.zeros((b, s), jnp.int32).at[:, 12:].set(1)  # 1 = pad out
+    m = SelfMultiheadAttn(e, h, impl="fast")
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x, key_padding_mask=pad, is_training=False)
+    ref = _ref_attention(x, params["params"], h, key_padding_mask=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # perturbing a padded key position must not change the output
+    x2 = x.at[14].add(3.0)
+    out2 = m.apply(params, x2, key_padding_mask=pad, is_training=False)
+    np.testing.assert_allclose(np.asarray(out[:12]), np.asarray(out2[:12]),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_self_attn_additive_mask_matches_binary(rng):
+    s, b, e, h = 12, 2, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    binary = jnp.zeros((b, s), jnp.int32).at[:, 9:].set(1)
+    additive = jnp.where(binary == 1, -10000.0, 0.0).astype(jnp.float32)
+    m_bin = SelfMultiheadAttn(e, h, impl="default")
+    m_add = SelfMultiheadAttn(e, h, impl="default", mask_additive=True,
+                              bias=True)
+    p_bin = m_bin.init(jax.random.PRNGKey(0), x)
+    p_add = m_add.init(jax.random.PRNGKey(0), x)
+    # graft the same projection weights (bias params are zero-init)
+    p_add = jax.tree.map(lambda a: a, p_add)
+    p_add["params"]["in_proj_weight"] = p_bin["params"]["in_proj_weight"]
+    p_add["params"]["out_proj_weight"] = p_bin["params"]["out_proj_weight"]
+    out_bin = m_bin.apply(p_bin, x, key_padding_mask=binary,
+                          is_training=False)
+    out_add = m_add.apply(p_add, x, key_padding_mask=additive,
+                          is_training=False)
+    np.testing.assert_allclose(np.asarray(out_bin), np.asarray(out_add),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_self_attn_norm_add(rng):
+    """include_norm_add: output = residual + attn(LN(x)); zero attention
+    weights would give back the residual."""
+    s, b, e, h = 8, 1, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    m = SelfMultiheadAttn(e, h, include_norm_add=True, impl="fast")
+    params = m.init(jax.random.PRNGKey(0), x)
+    out = m.apply(params, x, is_training=False)
+    # zero the out projection → pure residual
+    z = jax.tree.map(lambda a: a, params)
+    z["params"]["out_proj_weight"] = jnp.zeros_like(
+        z["params"]["out_proj_weight"])
+    res = m.apply(z, x, is_training=False)
+    np.testing.assert_allclose(np.asarray(res), np.asarray(x), rtol=1e-6)
+    assert not np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_self_attn_separate_qkv(rng):
+    s, b, e, h = 8, 2, 64, 4
+    x = jnp.asarray(rng.standard_normal((s, b, e)), jnp.float32)
+    m = SelfMultiheadAttn(e, h, separate_qkv_params=True, bias=True)
+    params = m.init(jax.random.PRNGKey(0), x)
+    names = set(params["params"].keys())
+    assert {"q_weight", "k_weight", "v_weight", "q_bias"} <= names
+    out = m.apply(params, x, is_training=False)
+    assert out.shape == x.shape and np.isfinite(np.asarray(out)).all()
+
+
+def test_encdec_attn(rng):
+    s_q, s_k, b, e, h = 8, 12, 2, 64, 4
+    q = jnp.asarray(rng.standard_normal((s_q, b, e)), jnp.float32)
+    kv = jnp.asarray(rng.standard_normal((s_k, b, e)), jnp.float32)
+    m = EncdecMultiheadAttn(e, h, impl="fast")
+    params = m.init(jax.random.PRNGKey(0), q, kv)
+    out = m.apply(params, q, kv, is_training=False)
+    assert out.shape == (s_q, b, e)
+    out_default = EncdecMultiheadAttn(e, h, impl="default").apply(
+        params, q, kv, is_training=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_default),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mask_softmax_dropout_func(rng):
+    b, h, sq, sk = 2, 4, 8, 8
+    scores = jnp.asarray(rng.standard_normal((b * h, sq, sk)), jnp.float32)
+    pad = jnp.zeros((b, sk), jnp.int32).at[:, 6:].set(1)
+    probs = fast_mask_softmax_dropout_func(False, h, scores, pad, False, 0.0)
+    assert probs.shape == scores.shape
+    np.testing.assert_allclose(np.asarray(jnp.sum(probs, -1)), 1.0, rtol=1e-5)
+    assert np.all(np.asarray(probs.reshape(b, h, sq, sk))[:, :, :, 6:] == 0)
+
+
+def test_fmha_varlen_matches_per_sequence(rng):
+    """Packed [total,3,h,d] attention == per-sequence dense attention."""
+    h, d = 2, 64
+    lens = [48, 80]
+    total = sum(lens)
+    qkv = jnp.asarray(rng.standard_normal((total, 3, h, d)), jnp.float32)
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    out = fmha_varlen(qkv, cu, causal=True)
+    assert out.shape == (total, h, d)
+    start = 0
+    for n in lens:
+        q = qkv[start:start + n, 0].transpose(1, 0, 2)[None]
+        k = qkv[start:start + n, 1].transpose(1, 0, 2)[None]
+        v = qkv[start:start + n, 2].transpose(1, 0, 2)[None]
+        ref = mha_reference(q, k, v, causal=True)[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(np.asarray(out[start:start + n]),
+                                   np.asarray(ref), rtol=2e-5, atol=2e-5)
+        start += n
+
+
+def test_segment_ids_from_cu_seqlens():
+    cu = jnp.asarray([0, 3, 7], jnp.int32)
+    seg = segment_ids_from_cu_seqlens(cu, 8)
+    np.testing.assert_array_equal(np.asarray(seg), [1, 1, 1, 2, 2, 2, 2, 0])

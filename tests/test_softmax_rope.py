@@ -156,3 +156,24 @@ def test_rope_2d(rng):
             t5[:, :, c, :, d // 2:],
             np.broadcast_to(ang_w[:, c:c + 1, :, :], (1, 1, 1, d // 2)))
     np.testing.assert_allclose(np.asarray(y).reshape(exp.shape), exp, rtol=1e-5, atol=1e-5)
+
+
+def test_causal_dispatcher_keeps_triangle_with_mask(rng):
+    """ADVICE r1: causal FusedScaleMaskSoftmax given a padding-only mask must
+    still apply the causal triangle (the reference asserts instead; we
+    compose)."""
+    from apex_tpu.transformer.enums import AttnMaskType
+    from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+
+    x = jnp.asarray(rng.standard_normal((2, 2, 8, 8)), jnp.float32)
+    pad = jnp.zeros((2, 1, 8, 8), bool).at[:, :, :, 6:].set(True)
+    probs = FusedScaleMaskSoftmax(
+        attn_mask_type=AttnMaskType.causal, scale=0.5)(x, pad)
+    p = np.asarray(probs)
+    # future positions (col > row) must carry zero probability
+    for r in range(8):
+        assert np.all(p[:, :, r, r + 1:] < 1e-6), r
+    # padding columns masked too
+    assert np.all(p[:, :, :, 6:] < 1e-6)
+    # kept rows still normalize
+    np.testing.assert_allclose(p[:, :, 1:, :].sum(-1), 1.0, rtol=1e-5)

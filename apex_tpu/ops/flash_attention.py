@@ -44,7 +44,13 @@ from jax.experimental import pallas as pl
 from apex_tpu.ops._dispatch import kernels_enabled, use_interpret
 
 _NEG_INF = -1e30
-_DEFAULT_BLOCK = 128
+# Large default tiles: at head dims of 64-128 a (128, d) step is too little
+# work to amortize grid overhead (measured 5 TF/s at 128x128 vs ~90 TF/s at
+# 1024x1024 on v5e, b8 h16 s1024 d64).  VMEM at 1024x1024: the fp32 p tile is
+# 4 MiB + q/k/v/do/acc tiles ≈ 7 MiB total — comfortably under the ~16 MiB
+# budget for d ≤ 128.  Longer sequences keep 1024-wide tiles and grid over
+# the rest (causal whole-block skip then prunes the upper triangle).
+_DEFAULT_BLOCK = 1024
 
 
 # ---------------------------------------------------------------------------

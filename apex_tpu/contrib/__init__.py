@@ -14,6 +14,7 @@ _SUBMODULES = (
     "clip_grad",
     "fmha",
     "multihead_attn",
+    "optimizers",
 )
 
 

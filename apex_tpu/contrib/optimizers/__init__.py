@@ -1,0 +1,19 @@
+"""apex_tpu.contrib.optimizers — ZeRO-2 distributed optimizers.
+
+Parity: ``apex.contrib.optimizers`` (DistributedFusedAdam — ZeRO-2,
+distributed_fused_adam.py:273; DistributedFusedLAMB,
+distributed_fused_lamb.py:24).  The legacy contrib FP16_Optimizer and
+deprecated fused adam/lamb wrappers are subsumed by
+:mod:`apex_tpu.fp16_utils` and :mod:`apex_tpu.optimizers`.
+"""
+
+from apex_tpu.contrib.optimizers._zero_base import ZeROOptimizer, ZeROState
+from apex_tpu.contrib.optimizers.distributed_fused_adam import DistributedFusedAdam
+from apex_tpu.contrib.optimizers.distributed_fused_lamb import DistributedFusedLAMB
+
+__all__ = [
+    "ZeROOptimizer",
+    "ZeROState",
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+]

@@ -1,0 +1,335 @@
+"""ZeRO-2 optimizer core: flat-sharded state over a mesh axis.
+
+TPU-native redesign of the reference's ``DistributedFusedAdam`` machinery
+(apex/contrib/optimizers/distributed_fused_adam.py:273 — flattened fixed-size
+buckets, optimizer state sharded over a ``distributed_process_group`` and
+replicated over a ``redundant_process_group``, overlapped grad reduce-scatter
+and param all-gather, bf16 ``store_param_remainders``, per-tensor scaled
+state).  The CUDA design hand-manages buckets, NCCL streams, and pipelined
+kernel launches; on TPU all of that collapses into ONE jitted step built from
+three primitives inside ``shard_map``:
+
+- grad sync     = ``lax.psum_scatter`` over the distributed mesh axis
+                  (the ZeRO-2 reduce-scatter, replacing DDP's allreduce),
+- local update  = an elementwise optimizer step on this rank's flat shard,
+- param sync    = ``lax.all_gather`` of the updated shards.
+
+XLA's latency-hiding scheduler provides the overlap the reference implements
+by hand (grad reduce-scatter during backward, param all-gather during the
+next forward) — the collectives are ordinary ops in the step graph.
+
+"Redundant" replication needs no code at all: shard along one mesh axis and
+the state is automatically replicated over every other axis, exactly how the
+reference's 2D ``distributed × redundant`` grid behaves.
+
+State layout: all params are flattened (fp32) into one padded 1-D buffer;
+each rank along ``distributed_axis`` owns a contiguous shard of size
+``padded_total / axis_size``.  Per-parameter quantities (LAMB trust ratios,
+per-tensor state scales) are computed with segment reductions over a static
+element→parameter id map, then ``psum``/``pmax`` across shards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.utils.packing import make_packed_spec, pack_pytree, unpack_pytree
+
+__all__ = ["ZeROState", "ZeROOptimizer"]
+
+
+def _axis_size(axis_name: Optional[str]) -> int:
+    """Static size of a mesh axis (1 when running unsharded)."""
+    if axis_name is None:
+        return 1
+    n = jax.lax.psum(1, axis_name)
+    if not isinstance(n, int):  # only when psum can't constant-fold
+        raise RuntimeError(
+            f"axis {axis_name!r} size is not static; call init/step inside "
+            "shard_map over a mesh that includes this axis")
+    return n
+
+
+def _split_bf16(x32: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """fp32 -> (bf16 high half, uint16 low half); exact round trip."""
+    bits = jax.lax.bitcast_convert_type(x32, jnp.uint32)
+    hi = jax.lax.bitcast_convert_type((bits >> 16).astype(jnp.uint16), jnp.bfloat16)
+    lo = (bits & jnp.uint32(0xFFFF)).astype(jnp.uint16)
+    return hi, lo
+
+
+def _merge_bf16(hi_bf16: jax.Array, lo_u16: jax.Array) -> jax.Array:
+    """(bf16 high half, uint16 low half) -> the exact fp32."""
+    hi = jax.lax.bitcast_convert_type(hi_bf16, jnp.uint16).astype(jnp.uint32)
+    bits = (hi << 16) | lo_u16.astype(jnp.uint32)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32)
+
+
+class ZeROState(NamedTuple):
+    """Sharded optimizer state; every ``*_shard`` leaf lives on the
+    distributed axis (use :meth:`ZeROOptimizer.state_specs` for out_specs)."""
+
+    step: jax.Array                       # i32 scalar, replicated
+    param_shard: Optional[jax.Array]      # fp32 [shard] master (store_params)
+    remainder_shard: Optional[jax.Array]  # u16 [shard] (store_param_remainders)
+    exp_avg: jax.Array                    # [shard], state_dtype
+    exp_avg_sq: jax.Array                 # [shard], state_dtype
+    exp_avg_scale: Optional[jax.Array]    # fp32 [n_params+1] per-tensor scales
+    exp_avg_sq_scale: Optional[jax.Array]
+
+
+class ZeROOptimizer:
+    """Shared ZeRO-2 machinery; subclasses implement ``_update_shard``.
+
+    Usage (inside ``shard_map`` over a mesh containing ``distributed_axis``)::
+
+        opt = DistributedFusedAdam(lr=1e-3, distributed_axis="dp")
+        state = opt.init(params)              # out_specs: opt.state_specs()
+        new_params, state = opt.step(grads, params, state)
+
+    ``grads`` are this rank's *local, unreduced* gradients — the optimizer
+    performs the gradient reduction itself (reduce-scatter), which is the
+    defining ZeRO-2 move.  Do NOT pre-``pmean`` them over the distributed
+    axis.
+    """
+
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        *,
+        distributed_axis: Optional[str] = "dp",
+        state_dtype=jnp.float32,
+        grad_sync_dtype=None,
+        param_sync_dtype=None,
+        average_grad_sync: bool = True,
+        store_params: bool = True,
+        store_param_remainders: bool = False,
+        with_scaled_states: bool = False,
+    ):
+        if store_param_remainders and not store_params:
+            raise ValueError("store_param_remainders requires store_params")
+        if with_scaled_states and jnp.dtype(state_dtype) == jnp.float32:
+            # scales on fp32 state are pure overhead; mirror the reference's
+            # intent (scaled state exists to keep fp16 state in range)
+            state_dtype = jnp.float16
+        self.lr = lr
+        self.distributed_axis = distributed_axis
+        self.state_dtype = jnp.dtype(state_dtype)
+        self.grad_sync_dtype = jnp.dtype(grad_sync_dtype) if grad_sync_dtype else jnp.dtype(jnp.float32)
+        self._param_sync_dtype = jnp.dtype(param_sync_dtype) if param_sync_dtype else None
+        self.average_grad_sync = average_grad_sync
+        self.store_params = store_params
+        self.store_param_remainders = store_param_remainders
+        self.with_scaled_states = with_scaled_states
+
+    # ---- static layout ---------------------------------------------------
+
+    def _layout(self, params: Any):
+        n = _axis_size(self.distributed_axis)
+        spec = make_packed_spec(params, pad_to=1024 * n)
+        shard = spec.padded_total // n
+        rank = (jax.lax.axis_index(self.distributed_axis)
+                if self.distributed_axis else 0)
+        return spec, n, shard, rank
+
+    def _segment_ids(self, spec) -> np.ndarray:
+        """Static element -> parameter-index map over the padded flat buffer
+        (padding gets the sentinel id ``num_leaves``)."""
+        ids = np.full((spec.padded_total,), spec.num_leaves, np.int32)
+        for i, (shape, off) in enumerate(zip(spec.shapes, spec.offsets)):
+            size = int(np.prod(shape)) if len(shape) else 1
+            ids[off:off + size] = i
+        return ids
+
+    def _shard_segment_ids(self, spec, shard: int, rank) -> jax.Array:
+        ids = jnp.asarray(self._segment_ids(spec))
+        return jax.lax.dynamic_slice(ids, (rank * shard,), (shard,))
+
+    def _param_sync_dtype_for(self, spec):
+        if self._param_sync_dtype is not None:
+            return self._param_sync_dtype
+        if self.store_param_remainders:
+            return jnp.dtype(jnp.bfloat16)
+        return jnp.dtype(jnp.float32)
+
+    def _check_remainder_dtypes(self, spec):
+        if self.store_param_remainders:
+            bad = [str(d) for d in spec.dtypes if jnp.dtype(d) != jnp.bfloat16]
+            if bad:
+                raise ValueError(
+                    "store_param_remainders needs every parameter in bf16 "
+                    f"(fp32 is reconstructed from bf16 bits); got {set(bad)}")
+
+    # ---- per-tensor scaled state (FP8-LM style) --------------------------
+
+    def _decode_state(self, x, scale, seg_ids):
+        if scale is None:
+            return x.astype(jnp.float32)
+        return x.astype(jnp.float32) * scale[seg_ids]
+
+    def _encode_state(self, x32, seg_ids, num_segments):
+        """Rescale so each parameter's state fills the fp16 dynamic range."""
+        if not self.with_scaled_states:
+            return x32.astype(self.state_dtype), None
+        per = jax.ops.segment_max(jnp.abs(x32), seg_ids,
+                                  num_segments=num_segments)
+        if self.distributed_axis:
+            per = jax.lax.pmax(per, self.distributed_axis)
+        # target max ~2^14: two bits of headroom under fp16's 65504
+        scale = jnp.maximum(per / 16384.0, jnp.float32(1e-30))
+        return (x32 / scale[seg_ids]).astype(self.state_dtype), scale
+
+    # ---- public API ------------------------------------------------------
+
+    def state_specs(self) -> ZeROState:
+        """PartitionSpecs for shard_map ``out_specs`` matching :meth:`init`."""
+        ax = self.distributed_axis
+        return ZeROState(
+            step=P(),
+            param_shard=P(ax) if (self.store_params and not self.store_param_remainders) else None,
+            remainder_shard=P(ax) if self.store_param_remainders else None,
+            exp_avg=P(ax),
+            exp_avg_sq=P(ax),
+            exp_avg_scale=P() if self.with_scaled_states else None,
+            exp_avg_sq_scale=P() if self.with_scaled_states else None,
+        )
+
+    def init(self, params: Any) -> ZeROState:
+        spec, n, shard, rank = self._layout(params)
+        self._check_remainder_dtypes(spec)
+        flat32 = pack_pytree(params, dtype=jnp.float32, pad_to=1024 * n).flat
+        master = jax.lax.dynamic_slice(flat32, (rank * shard,), (shard,))
+
+        param_shard = remainder = None
+        if self.store_param_remainders:
+            _, remainder = _split_bf16(master)
+        elif self.store_params:
+            param_shard = master
+
+        zeros = jnp.zeros((shard,), self.state_dtype)
+        scales = None
+        if self.with_scaled_states:
+            scales = jnp.full((spec.num_leaves + 1,), 1e-30, jnp.float32)
+        return ZeROState(
+            step=jnp.int32(0),
+            param_shard=param_shard,
+            remainder_shard=remainder,
+            exp_avg=zeros,
+            exp_avg_sq=jnp.copy(zeros),
+            exp_avg_scale=scales,
+            exp_avg_sq_scale=None if scales is None else jnp.copy(scales),
+        )
+
+    def _master_shard(self, state: ZeROState, flat_param_shard: jax.Array):
+        """Recover this rank's fp32 master values."""
+        if self.store_param_remainders:
+            return _merge_bf16(flat_param_shard, state.remainder_shard)
+        if self.store_params:
+            return state.param_shard
+        return flat_param_shard.astype(jnp.float32)
+
+    def step(
+        self,
+        grads: Any,
+        params: Any,
+        state: ZeROState,
+        *,
+        grad_scale: Optional[jax.Array] = None,
+        found_inf: Optional[jax.Array] = None,
+    ):
+        spec, n, shard, rank = self._layout(params)
+        ax = self.distributed_axis
+
+        # -- gradient reduce-scatter (the ZeRO-2 sync) ---------------------
+        flat_g = pack_pytree(grads, dtype=self.grad_sync_dtype,
+                             pad_to=1024 * n).flat
+        if ax:
+            g_shard = jax.lax.psum_scatter(flat_g, ax, scatter_dimension=0,
+                                           tiled=True)
+        else:
+            g_shard = flat_g
+        g32 = g_shard.astype(jnp.float32)
+        if self.average_grad_sync:
+            g32 = g32 / n
+        if grad_scale is not None:
+            g32 = g32 * (1.0 / jnp.asarray(grad_scale, jnp.float32))
+
+        # -- local shard update --------------------------------------------
+        psync_dtype = self._param_sync_dtype_for(spec)
+        flat_p_shard = jax.lax.dynamic_slice(
+            pack_pytree(params, dtype=psync_dtype, pad_to=1024 * n).flat,
+            (rank * shard,), (shard,))
+        master = self._master_shard(state, flat_p_shard)
+        seg_ids = self._shard_segment_ids(spec, shard, rank)
+
+        step_count = state.step + 1
+        m32 = self._decode_state(state.exp_avg, state.exp_avg_scale, seg_ids)
+        v32 = self._decode_state(state.exp_avg_sq, state.exp_avg_sq_scale, seg_ids)
+
+        new_master, new_m32, new_v32 = self._update_shard(
+            g32, master, m32, v32, step_count,
+            seg_ids=seg_ids, num_segments=spec.num_leaves + 1)
+
+        new_m, m_scale = self._encode_state(new_m32, seg_ids, spec.num_leaves + 1)
+        new_v, v_scale = self._encode_state(new_v32, seg_ids, spec.num_leaves + 1)
+
+        new_param_shard = new_remainder = None
+        if self.store_param_remainders:
+            out_shard, new_remainder = _split_bf16(new_master)
+        else:
+            if self.store_params:
+                new_param_shard = new_master
+            out_shard = new_master.astype(psync_dtype)
+
+        new_state = ZeROState(
+            step=step_count,
+            param_shard=new_param_shard,
+            remainder_shard=new_remainder,
+            exp_avg=new_m,
+            exp_avg_sq=new_v,
+            exp_avg_scale=m_scale,
+            exp_avg_sq_scale=v_scale,
+        )
+
+        # -- dynamic-loss-scale skip (capturable semantics) ----------------
+        if found_inf is not None:
+            keep = lambda new, old: jax.tree.map(
+                lambda a, b: jnp.where(found_inf, b, a), new, old)
+            out_shard = keep(out_shard, flat_p_shard)
+            new_state = keep(new_state, state._replace(step=step_count))
+
+        # -- parameter all-gather ------------------------------------------
+        if ax:
+            flat_new = jax.lax.all_gather(out_shard, ax, tiled=True)
+        else:
+            flat_new = out_shard
+        new_params = unpack_pytree(flat_new, spec)
+        return new_params, new_state
+
+    # -- subclass hook -----------------------------------------------------
+
+    def _update_shard(self, g32, master, m32, v32, step_count, *,
+                      seg_ids, num_segments):
+        """Return (new_master, new_m32, new_v32), all fp32 [shard]."""
+        raise NotImplementedError
+
+    # -- norm helpers shared by subclasses ---------------------------------
+
+    def _global_sqsum(self, x32: jax.Array) -> jax.Array:
+        s = jnp.sum(jnp.square(x32))
+        if self.distributed_axis:
+            s = jax.lax.psum(s, self.distributed_axis)
+        return s
+
+    def _per_param_sqsum(self, x32, seg_ids, num_segments) -> jax.Array:
+        s = jax.ops.segment_sum(jnp.square(x32), seg_ids,
+                                num_segments=num_segments)
+        if self.distributed_axis:
+            s = jax.lax.psum(s, self.distributed_axis)
+        return s

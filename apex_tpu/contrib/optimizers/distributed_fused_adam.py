@@ -1,0 +1,61 @@
+"""DistributedFusedAdam — Adam/AdamW with ZeRO-2 sharded state.
+
+Parity target: ``apex.contrib.optimizers.DistributedFusedAdam``
+(apex/contrib/optimizers/distributed_fused_adam.py:273): optimizer state and
+gradient reduction distributed over the data-parallel ranks, with options for
+state dtype, bf16 param remainders, and per-tensor scaled state.  The math is
+identical to :class:`apex_tpu.optimizers.FusedAdam` (and the reference's
+``multi_tensor_adam``); the distribution machinery lives in
+:class:`apex_tpu.contrib.optimizers._zero_base.ZeROOptimizer`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.contrib.optimizers._zero_base import ZeROOptimizer
+from apex_tpu.optimizers._common import bias_corrections
+
+__all__ = ["DistributedFusedAdam"]
+
+
+class DistributedFusedAdam(ZeROOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-8,
+        adam_w_mode: bool = True,
+        weight_decay: float = 0.0,
+        amsgrad: bool = False,
+        **zero_kwargs,
+    ):
+        if amsgrad:
+            raise RuntimeError(
+                "DistributedFusedAdam does not support the AMSGrad variant.")
+        super().__init__(lr, **zero_kwargs)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.adam_w_mode = adam_w_mode
+        self.weight_decay = weight_decay
+
+    def _update_shard(self, g32, master, m32, v32, step_count, *,
+                      seg_ids, num_segments):
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step_count, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        if not self.adam_w_mode and self.weight_decay:
+            g32 = g32 + wd * master  # L2 regularization into the gradient
+        m32 = b1 * m32 + (1.0 - b1) * g32
+        v32 = b2 * v32 + (1.0 - b2) * g32 * g32
+        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if self.adam_w_mode and self.weight_decay:
+            update = update + wd * master  # decoupled (AdamW)
+        return master - lr * update, m32, v32

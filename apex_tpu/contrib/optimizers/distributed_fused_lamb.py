@@ -1,0 +1,78 @@
+"""DistributedFusedLAMB — LAMB with ZeRO-2 sharded state.
+
+Parity target: ``apex.contrib.optimizers.DistributedFusedLAMB``
+(apex/contrib/optimizers/distributed_fused_lamb.py:24): ZeRO-style LAMB with
+fused global-grad-norm clipping before the update and per-tensor trust
+ratios.  On TPU the per-tensor norms over a *sharded* flat buffer are segment
+reductions over a static element→parameter map, ``psum``-combined across the
+distributed axis — one fused graph instead of the reference's two-stage
+multi-tensor kernel pipeline.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.contrib.optimizers._zero_base import ZeROOptimizer
+from apex_tpu.optimizers._common import bias_corrections
+
+__all__ = ["DistributedFusedLAMB"]
+
+
+class DistributedFusedLAMB(ZeROOptimizer):
+    def __init__(
+        self,
+        lr: float = 1e-3,
+        bias_correction: bool = True,
+        betas=(0.9, 0.999),
+        eps: float = 1e-6,
+        weight_decay: float = 0.01,
+        adam_w_mode: bool = True,
+        grad_averaging: bool = True,
+        max_grad_norm: float = 1.0,
+        use_nvlamb: bool = False,
+        **zero_kwargs,
+    ):
+        super().__init__(lr, **zero_kwargs)
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.grad_averaging = grad_averaging
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _update_shard(self, g32, master, m32, v32, step_count, *,
+                      seg_ids, num_segments):
+        # global grad-norm clipping (the reference's fused pre-LAMB clip)
+        if self.max_grad_norm:
+            gnorm = jnp.sqrt(self._global_sqsum(g32))
+            g32 = g32 / jnp.maximum(gnorm / self.max_grad_norm, 1.0)
+
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step_count, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        beta3 = 1.0 - self.beta1 if self.grad_averaging else 1.0
+        lr = jnp.float32(self.lr)
+        wd = jnp.float32(self.weight_decay)
+        b1, b2, eps = self.beta1, self.beta2, self.eps
+
+        if not self.adam_w_mode and self.weight_decay:
+            g32 = g32 + wd * master
+        m32 = b1 * m32 + beta3 * g32
+        v32 = b2 * v32 + (1.0 - b2) * g32 * g32
+        update = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+        if self.adam_w_mode and self.weight_decay:
+            update = update + wd * master
+
+        # per-parameter trust ratio ||p|| / ||update|| across the shards
+        p_sq = self._per_param_sqsum(master, seg_ids, num_segments)
+        u_sq = self._per_param_sqsum(update, seg_ids, num_segments)
+        p_norm, u_norm = jnp.sqrt(p_sq), jnp.sqrt(u_sq)
+        ratio = jnp.where((p_norm > 0) & (u_norm > 0), p_norm / u_norm,
+                          jnp.float32(1.0))
+        if not (self.weight_decay or self.use_nvlamb):
+            ratio = jnp.ones_like(ratio)
+        return master - lr * ratio[seg_ids] * update, m32, v32

@@ -56,8 +56,10 @@ class LARC:
             mult = jnp.where(ok, mult, 1.0)
             # the reference folds weight decay into the gradient BEFORE the
             # adaptive scaling and zeroes the group's wd (LARC.py:95-105), so
-            # decay is applied at the adaptive rate, not the full rate
-            g32 = g32 + wd * p32
+            # decay is applied at the adaptive rate, not the full rate.  Like
+            # the reference, the fold happens only inside the nonzero-norm
+            # branch — zero-norm params' grads pass through untouched.
+            g32 = g32 + jnp.where(ok, wd, 0.0) * p32
             return (g32 * mult).astype(g.dtype)
 
         return jax.tree.map(scale_leaf, grads, params)

@@ -1,0 +1,189 @@
+"""ZeRO-2 distributed optimizers: numeric parity with the non-distributed
+fused optimizers on an 8-device CPU mesh, plus state-sharding memory
+accounting (VERDICT round-1 item 3; reference
+apex/contrib/optimizers/distributed_fused_adam.py, distributed_fused_lamb.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.optimizers import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+)
+from apex_tpu.contrib.optimizers._zero_base import _merge_bf16, _split_bf16
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+
+N_STEPS = 3
+
+
+def make_params(rng, dtype=jnp.float32):
+    return {
+        "w": jnp.asarray(rng.normal(size=(17, 9)), dtype),
+        "b": jnp.asarray(rng.normal(size=(9,)), dtype),
+        "ln": {"scale": jnp.asarray(1.0 + 0.1 * rng.normal(size=(33,)), dtype)},
+    }
+
+
+def make_grads(rng, params):
+    return jax.tree.map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32), params)
+
+
+def run_distributed(opt, params, base_grads, mesh, **step_kw):
+    """N_STEPS of opt on the dp mesh; rank r's local grad = base * (r+1),
+    so the reduced (mean) gradient is base * mean(1..8) = base * 4.5."""
+
+    def fn(params, base_grads):
+        state = opt.init(params)
+        rank = jax.lax.axis_index("dp")
+        scale = (rank + 1).astype(jnp.float32)
+        for _ in range(N_STEPS):
+            grads = jax.tree.map(lambda g: g * scale, base_grads)
+            params, state = opt.step(grads, params, state, **step_kw)
+        return params
+
+    with mesh:
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, base_grads)
+
+
+def run_reference(opt, params, base_grads):
+    """N_STEPS of the non-distributed optimizer on the mean gradient."""
+    state = opt.init(params)
+    grads = jax.tree.map(lambda g: g * 4.5, base_grads)
+    for _ in range(N_STEPS):
+        params, state = opt.step(grads, params, state)
+    return params
+
+
+def assert_trees_close(a, b, rtol=1e-5, atol=1e-6):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32),
+            rtol=rtol, atol=atol),
+        a, b)
+
+
+def test_split_merge_bf16_roundtrip(rng):
+    x = jnp.asarray(rng.normal(size=(257,)) * 1e3, jnp.float32)
+    hi, lo = _split_bf16(x)
+    assert hi.dtype == jnp.bfloat16 and lo.dtype == jnp.uint16
+    np.testing.assert_array_equal(np.asarray(_merge_bf16(hi, lo)), np.asarray(x))
+
+
+@pytest.mark.parametrize("adam_w_mode", [True, False])
+def test_distributed_adam_matches_fused_adam(mesh8, rng, adam_w_mode):
+    params = make_params(rng)
+    grads = make_grads(rng, params)
+    kw = dict(lr=1e-2, weight_decay=0.02, adam_w_mode=adam_w_mode)
+    got = run_distributed(DistributedFusedAdam(**kw), params, grads, mesh8)
+    want = run_reference(FusedAdam(**kw), params, grads)
+    assert_trees_close(got, want)
+
+
+def test_distributed_lamb_matches_fused_lamb(mesh8, rng):
+    params = make_params(rng)
+    grads = make_grads(rng, params)
+    kw = dict(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+    got = run_distributed(DistributedFusedLAMB(**kw), params, grads, mesh8)
+    want = run_reference(FusedLAMB(**kw), params, grads)
+    assert_trees_close(got, want)
+
+
+def test_store_param_remainders_tracks_fp32_master(mesh8, rng):
+    """bf16 params + uint16 remainders == an exact fp32 master trajectory
+    (reference's store_param_remainders,
+    distributed_fused_adam.py 'store_param_remainders')."""
+    params32 = make_params(rng)
+    params16 = jax.tree.map(lambda p: p.astype(jnp.bfloat16), params32)
+    grads = make_grads(rng, params32)
+    got = run_distributed(
+        DistributedFusedAdam(lr=1e-2, store_param_remainders=True),
+        params16, grads, mesh8)
+    assert all(p.dtype == jnp.bfloat16 for p in jax.tree.leaves(got))
+    # master-weight FusedAdam keeps the same exact fp32 master; model params
+    # differ only by bf16 rounding mode (truncation vs RNE) => 1 ulp
+    want = run_reference(
+        FusedAdam(lr=1e-2, master_weights=True),
+        params16, grads)
+    assert_trees_close(got, want, rtol=1e-2, atol=1e-2)
+
+
+def test_store_param_remainders_requires_bf16(mesh8, rng):
+    params = make_params(rng)  # fp32
+    grads = make_grads(rng, params)
+    with pytest.raises(Exception, match="bf16"):
+        run_distributed(
+            DistributedFusedAdam(store_param_remainders=True),
+            params, grads, mesh8)
+
+
+def test_scaled_states_fp16(mesh8, rng):
+    """with_scaled_states keeps fp16 state near fp32 parity via per-tensor
+    scales (the FP8-LM trick, distributed_fused_adam.py with_scaled_states)."""
+    params = make_params(rng)
+    # tiny grads would underflow unscaled fp16 state (min normal ~6e-5)
+    grads = jax.tree.map(lambda g: g * 1e-6, make_grads(rng, params))
+    opt = DistributedFusedAdam(lr=1e-3, with_scaled_states=True)
+    assert opt.state_dtype == jnp.float16
+    got = run_distributed(opt, params, grads, mesh8)
+    want = run_reference(FusedAdam(lr=1e-3), params, grads)
+    assert_trees_close(got, want, rtol=2e-3, atol=1e-6)
+    # and the state really was stored in fp16: unscaled fp16 state on these
+    # gradients would flush the second moment (~1e-12²) to zero and the
+    # update to garbage — parity above is the evidence the scales work
+
+
+def test_found_inf_skips_update(mesh8, rng):
+    params = make_params(rng)
+    grads = make_grads(rng, params)
+    opt = DistributedFusedAdam(lr=1e-2)
+
+    def fn(params, grads):
+        state = opt.init(params)
+        new_params, new_state = opt.step(
+            grads, params, state, found_inf=jnp.bool_(True))
+        return new_params, new_state.step
+
+    with mesh8:
+        new_params, step = jax.jit(shard_map(
+            fn, mesh=mesh8, in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False))(params, grads)
+    assert int(step) == 1
+    assert_trees_close(new_params, params, rtol=0, atol=0)
+
+
+def test_state_is_sharded_over_dp(mesh8, rng):
+    """Memory accounting: each device holds 1/8 of the flat state, vs the
+    non-distributed optimizer's full replica (the point of ZeRO)."""
+    params = make_params(rng)
+    opt = DistributedFusedAdam(lr=1e-2, distributed_axis="dp")
+
+    with mesh8:
+        state = jax.jit(shard_map(
+            opt.init, mesh=mesh8, in_specs=(P(),),
+            out_specs=opt.state_specs(), check_vma=False))(params)
+
+    total = state.exp_avg.shape[0]
+    n_elems = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert total % (1024 * 8) == 0 and total >= n_elems
+    for arr in (state.exp_avg, state.exp_avg_sq, state.param_shard):
+        shards = arr.addressable_shards
+        assert len(shards) == 8
+        assert all(s.data.shape == (total // 8,) for s in shards)
+
+
+def test_grad_sync_dtype_bf16(mesh8, rng):
+    params = make_params(rng)
+    grads = make_grads(rng, params)
+    got = run_distributed(
+        DistributedFusedAdam(lr=1e-2, grad_sync_dtype=jnp.bfloat16),
+        params, grads, mesh8)
+    want = run_reference(FusedAdam(lr=1e-2), params, grads)
+    assert_trees_close(got, want, rtol=2e-2, atol=2e-2)

@@ -39,6 +39,7 @@ import numpy as np
 
 from jax.sharding import PartitionSpec as P
 
+from apex_tpu.optimizers._common import apply_if_finite
 from apex_tpu.utils.packing import make_packed_spec, pack_pytree, unpack_pytree
 
 __all__ = ["ZeROState", "ZeROOptimizer"]
@@ -104,7 +105,7 @@ class ZeROOptimizer:
         lr: float = 1e-3,
         *,
         distributed_axis: Optional[str] = "dp",
-        state_dtype=jnp.float32,
+        state_dtype=None,
         grad_sync_dtype=None,
         param_sync_dtype=None,
         average_grad_sync: bool = True,
@@ -114,10 +115,14 @@ class ZeROOptimizer:
     ):
         if store_param_remainders and not store_params:
             raise ValueError("store_param_remainders requires store_params")
-        if with_scaled_states and jnp.dtype(state_dtype) == jnp.float32:
-            # scales on fp32 state are pure overhead; mirror the reference's
-            # intent (scaled state exists to keep fp16 state in range)
-            state_dtype = jnp.float16
+        if state_dtype is None:
+            # scaled state exists to keep low-precision state in range, so it
+            # implies fp16 state; otherwise default to fp32
+            state_dtype = jnp.float16 if with_scaled_states else jnp.float32
+        elif with_scaled_states and jnp.dtype(state_dtype) == jnp.float32:
+            raise ValueError(
+                "with_scaled_states keeps per-tensor scales for low-precision "
+                "state; it is incompatible with explicit state_dtype=float32")
         self.lr = lr
         self.distributed_axis = distributed_axis
         self.state_dtype = jnp.dtype(state_dtype)
@@ -297,12 +302,11 @@ class ZeROOptimizer:
             exp_avg_sq_scale=v_scale,
         )
 
-        # -- dynamic-loss-scale skip (capturable semantics) ----------------
-        if found_inf is not None:
-            keep = lambda new, old: jax.tree.map(
-                lambda a, b: jnp.where(found_inf, b, a), new, old)
-            out_shard = keep(out_shard, flat_p_shard)
-            new_state = keep(new_state, state._replace(step=step_count))
+        # -- dynamic-loss-scale skip (capturable semantics): the WHOLE state
+        # reverts, step included, matching FusedOptimizer.step so bias
+        # corrections stay in lockstep with the non-ZeRO optimizers
+        out_shard = apply_if_finite(found_inf, out_shard, flat_p_shard)
+        new_state = apply_if_finite(found_inf, new_state, state)
 
         # -- parameter all-gather ------------------------------------------
         if ax:

@@ -1,89 +1,112 @@
 """Wall timers with optional device sync + TensorBoard export.
 
-Parity: ``apex.transformer.pipeline_parallel._timers`` (_timers.py:6-79):
-named timers with ``start/stop/elapsed/log/write``; the reference's
-``torch.cuda.synchronize`` option maps to ``jax.block_until_ready`` on a
-token (or the caller's outputs) — on TPU, dispatch is async exactly like CUDA.
+Parity surface: ``apex.transformer.pipeline_parallel._timers`` (named timers
+with ``start/stop/elapsed/log/write``).  The reference's
+``torch.cuda.synchronize`` option maps to ``jax.effects_barrier`` — TPU
+dispatch is async exactly like CUDA, so an unsynchronized stop() only times
+enqueue cost.
+
+Design (TPU-idiomatic, not a port): a timer is a tiny accumulator with a
+``timing()`` contextmanager as the preferred interface; ``start``/``stop``
+remain for schedule code that brackets non-lexical regions.
 """
 
 from __future__ import annotations
 
+import contextlib
+import logging
 import time
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List
 
 import jax
 
+logger = logging.getLogger(__name__)
+
 
 class _Timer:
+    """Accumulating wall timer for one named region."""
+
+    __slots__ = ("name", "total", "running", "_t0")
+
     def __init__(self, name: str):
-        self.name_ = name
-        self.elapsed_ = 0.0
-        self.started_ = False
-        self.start_time = time.time()
+        self.name = name
+        self.total = 0.0          # accumulated seconds across start/stop pairs
+        self.running = False
+        self._t0 = 0.0
 
-    def start(self, barrier: bool = False):
-        if self.started_:
-            raise AssertionError("timer has already been started")
+    def start(self, barrier: bool = False) -> None:
+        if self.running:
+            raise RuntimeError(f"timer {self.name!r} is already running")
         if barrier:
             jax.effects_barrier()
-        self.start_time = time.time()
-        self.started_ = True
+        self.running = True
+        self._t0 = time.perf_counter()
 
-    def stop(self, barrier: bool = False):
-        if not self.started_:
-            raise AssertionError("timer is not started")
+    def stop(self, barrier: bool = False) -> None:
+        if not self.running:
+            raise RuntimeError(f"timer {self.name!r} was never started")
         if barrier:
             jax.effects_barrier()
-        self.elapsed_ += time.time() - self.start_time
-        self.started_ = False
+        self.total += time.perf_counter() - self._t0
+        self.running = False
 
-    def reset(self):
-        self.elapsed_ = 0.0
-        self.started_ = False
+    @contextlib.contextmanager
+    def timing(self, barrier: bool = False) -> Iterator["_Timer"]:
+        """``with timers('fwd').timing(): ...`` — the idiomatic bracket."""
+        self.start(barrier=barrier)
+        try:
+            yield self
+        finally:
+            self.stop(barrier=barrier)
+
+    def reset(self) -> None:
+        self.total = 0.0
+        self.running = False
 
     def elapsed(self, reset: bool = True) -> float:
-        started = self.started_
-        if started:
+        """Accumulated seconds; pauses/resumes a running timer around the read."""
+        was_running = self.running
+        if was_running:
             self.stop()
-        e = self.elapsed_
+        seconds = self.total
         if reset:
             self.reset()
-        if started:
+        if was_running:
             self.start()
-        return e
+        return seconds
 
 
 class Timers:
-    """Group of named timers (_timers.py Timers)."""
+    """Registry of named timers; calling it creates on first use."""
 
     def __init__(self):
-        self.timers: Dict[str, _Timer] = {}
+        self._timers: Dict[str, _Timer] = {}
 
     def __call__(self, name: str) -> _Timer:
-        if name not in self.timers:
-            self.timers[name] = _Timer(name)
-        return self.timers[name]
+        try:
+            return self._timers[name]
+        except KeyError:
+            t = self._timers[name] = _Timer(name)
+            return t
 
     def write(self, names: List[str], writer, iteration: int,
-              normalizer: float = 1.0, reset: bool = False):
-        """TensorBoard export (_timers.py:52-64); ``writer`` is any object
-        with ``add_scalar(tag, value, step)``."""
+              normalizer: float = 1.0, reset: bool = False) -> None:
+        """Export per-name mean seconds to any ``add_scalar(tag, val, step)``
+        sink (TensorBoard SummaryWriter shaped)."""
         if normalizer <= 0.0:
-            raise AssertionError
+            raise ValueError(f"normalizer must be positive, got {normalizer}")
         for name in names:
-            value = self.timers[name].elapsed(reset=reset) / normalizer
-            writer.add_scalar(f"{name}-time", value, iteration)
+            seconds = self._timers[name].elapsed(reset=reset) / normalizer
+            writer.add_scalar(f"{name}-time", seconds, iteration)
 
     def log(self, names: List[str], normalizer: float = 1.0,
             reset: bool = True) -> str:
         if normalizer <= 0.0:
-            raise AssertionError
-        parts = ["time (ms)"]
-        for name in names:
-            t = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
-            parts.append(f" | {name}: {t:.2f}")
-        line = "".join(parts)
-        import logging
-
-        logging.getLogger(__name__).info(line)
+            raise ValueError(f"normalizer must be positive, got {normalizer}")
+        cells = [
+            f"{name}: {self._timers[name].elapsed(reset=reset) * 1e3 / normalizer:.2f}"
+            for name in names
+        ]
+        line = "time (ms) | " + " | ".join(cells)
+        logger.info(line)
         return line

@@ -290,7 +290,7 @@ def test_microbatch_calculators():
     assert r.get() == 2  # start 16 / (4*2)
     r.update(96, True)
     assert r.get_current_global_batch_size() == 64
-    with pytest.raises(AssertionError):
+    with pytest.raises(ValueError):
         build_num_microbatches_calculator(0, None, 30, 4, 2)
 
 

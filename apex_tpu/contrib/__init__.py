@@ -13,8 +13,11 @@ import importlib as _importlib
 _SUBMODULES = (
     "clip_grad",
     "fmha",
+    "focal_loss",
     "multihead_attn",
     "optimizers",
+    "transducer",
+    "xentropy",
 )
 
 

@@ -1,0 +1,3 @@
+from apex_tpu.contrib.focal_loss.focal_loss import FocalLoss, focal_loss
+
+__all__ = ["FocalLoss", "focal_loss"]

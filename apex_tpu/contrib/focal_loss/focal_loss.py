@@ -1,0 +1,62 @@
+"""Sigmoid focal loss (RetinaNet/EfficientDet head loss).
+
+Parity target: ``apex.contrib.focal_loss.focal_loss``
+(focal_loss.py:42-60 + csrc/focal_loss/focal_loss_cuda_kernel.cu:19-115):
+
+- ``cls_output`` [..., C_padded] raw logits; only the first
+  ``num_real_classes`` columns carry loss/grad (detection heads pad C to a
+  multiple of the vector width).
+- ``cls_targets_at_level`` [...] int class ids; negative ids mean "no
+  positive class" (every class treated as a negative).
+- label smoothing re-targets ``y' = (1-s)*onehot + s/C`` (kernel's
+  pp/pn/np/nn_norm constants with ``K = num_real_classes``).
+- the summed loss is normalized by the scalar ``num_positives_sum``.
+
+Per element: ``loss = y'*alpha*(1-p)^g*(-log p) + (1-y')*(1-alpha)*p^g*
+(-log(1-p))`` — for hard targets this is exactly
+``torchvision.ops.sigmoid_focal_loss`` (the reference's test oracle).
+
+No custom_vjp: the loss is a scalar reduction over elementwise math, so
+JAX AD + XLA yield the same recompute-in-backward the reference's
+``partial_grad`` trick exists to get.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["FocalLoss", "focal_loss"]
+
+
+def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+               num_real_classes, alpha, gamma, label_smoothing=0.0):
+    """Summed sigmoid focal loss normalized by ``num_positives_sum``."""
+    x = cls_output[..., :num_real_classes].astype(jnp.float32)
+    targets = cls_targets_at_level.astype(jnp.int32)
+
+    # negative ids (ignore/background sentinels) -> no positive column
+    onehot = jax.nn.one_hot(targets, num_real_classes, dtype=jnp.float32)
+    onehot = jnp.where((targets >= 0)[..., None], onehot, 0.0)
+    y = ((1.0 - label_smoothing) * onehot
+         + label_smoothing / num_real_classes * jnp.ones_like(onehot)
+         if label_smoothing else onehot)
+
+    # stable -log(sigmoid(x)) / -log(1-sigmoid(x))
+    neg_log_p = jax.nn.softplus(-x)
+    neg_log_1p = jax.nn.softplus(x)
+    p = jax.nn.sigmoid(x)
+
+    per_elem = (y * alpha * jnp.power(1.0 - p, gamma) * neg_log_p
+                + (1.0 - y) * (1.0 - alpha) * jnp.power(p, gamma) * neg_log_1p)
+    return jnp.sum(per_elem) / jnp.asarray(num_positives_sum, jnp.float32)
+
+
+class FocalLoss:
+    """Function-object form matching the reference's ``.apply`` call style."""
+
+    @staticmethod
+    def apply(cls_output, cls_targets_at_level, num_positives_sum,
+              num_real_classes, alpha, gamma, label_smoothing=0.0):
+        return focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
+                          num_real_classes, alpha, gamma, label_smoothing)

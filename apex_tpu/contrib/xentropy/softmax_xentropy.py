@@ -1,0 +1,84 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+Parity target: ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss``
+(softmax_xentropy.py:6-31 + csrc/xentropy/xentropy_kernel.cu): per-row loss
+
+    loss = (1 - smoothing) * nll + smoothing * smooth_loss
+    nll         = -logprob[label]
+    smooth_loss = -mean_v(logprob)
+
+with rows whose ``label == padding_idx`` zeroed (forward AND backward), and
+fp32 accumulation for half-precision logits (``half_to_float``).
+
+The fusion the reference buys with a CUDA kernel is a *memory* contract: the
+backward saves the logits plus one scalar per row (``max_log_sum_exp``), not
+the [N, V] softmax.  Here that contract is expressed as a ``custom_vjp``
+whose residuals are ``(logits, mlse, labels)`` — the cotangent recomputes
+``softmax = exp(logits - mlse)`` on the fly and XLA fuses the whole backward
+into one pass over the logits.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy_loss"]
+
+
+def _row_stats(logits32: jax.Array):
+    """log-sum-exp per row — the single saved scalar of the kernel."""
+    return jax.nn.logsumexp(logits32, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing=0.0, padding_idx=0):
+    """Per-row smoothed CE; [N] fp32 losses for [N, V] logits, [N] int labels."""
+    loss, _ = _forward(logits, labels, smoothing, padding_idx)
+    return loss
+
+
+def _forward(logits, labels, smoothing, padding_idx):
+    x32 = logits.astype(jnp.float32)
+    mlse = _row_stats(x32)                      # [N]
+    logprobs = x32 - mlse[..., None]            # [N, V]
+    nll = -jnp.take_along_axis(
+        logprobs, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    smooth = -jnp.mean(logprobs, axis=-1)
+    loss = (1.0 - smoothing) * nll + smoothing * smooth
+    loss = jnp.where(labels == padding_idx, 0.0, loss)
+    return loss, mlse
+
+
+def _fwd(logits, labels, smoothing, padding_idx):
+    loss, mlse = _forward(logits, labels, smoothing, padding_idx)
+    return loss, (logits, mlse, labels)
+
+
+def _bwd(smoothing, padding_idx, residuals, grad_loss):
+    logits, mlse, labels = residuals
+    x32 = logits.astype(jnp.float32)
+    softmax = jnp.exp(x32 - mlse[..., None])    # recomputed, never saved
+    vocab = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, vocab, dtype=jnp.float32)
+    # d/dx [(1-s)*nll + s*smooth] = softmax - (1-s)*onehot - s/V
+    dlogits = softmax - (1.0 - smoothing) * onehot - smoothing / vocab
+    g = jnp.where(labels == padding_idx, 0.0, grad_loss.astype(jnp.float32))
+    dlogits = dlogits * g[..., None]
+    return dlogits.astype(logits.dtype), None
+
+
+softmax_cross_entropy_loss.defvjp(_fwd, _bwd)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Function-object form matching the reference's ``.apply`` call style."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        del half_to_float  # losses are always accumulated/returned in fp32
+        return softmax_cross_entropy_loss(logits, labels, smoothing,
+                                          padding_idx)

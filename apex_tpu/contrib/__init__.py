@@ -12,8 +12,13 @@ import importlib as _importlib
 # as modules land (SURVEY.md §7 Phase 6).
 _SUBMODULES = (
     "clip_grad",
+    "conv_bias_relu",
+    "cudnn_gbn",
     "fmha",
     "focal_loss",
+    "group_norm",
+    "groupbn",
+    "index_mul_2d",
     "multihead_attn",
     "optimizers",
     "transducer",

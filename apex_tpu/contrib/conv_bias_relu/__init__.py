@@ -1,0 +1,9 @@
+from apex_tpu.contrib.conv_bias_relu.conv_bias_relu import (
+    ConvBias,
+    ConvBiasMaskReLU,
+    ConvBiasReLU,
+    ConvFrozenScaleBiasReLU,
+)
+
+__all__ = ["ConvBias", "ConvBiasMaskReLU", "ConvBiasReLU",
+           "ConvFrozenScaleBiasReLU"]

@@ -1,0 +1,62 @@
+"""Fused conv + bias (+ mask) (+ ReLU) for channels-last tensors.
+
+Parity target: ``apex.contrib.conv_bias_relu``
+(conv_bias_relu.py:12-105): four cuDNN-runtime-fusion graphs —
+ConvBiasReLU, ConvBiasMaskReLU, ConvBias, ConvFrozenScaleBiasReLU — over
+fp16 NHWC tensors.
+
+TPU design: ``lax.conv_general_dilated`` with NHWC dimension numbers hits
+the MXU directly, and XLA fuses the bias/scale/mask/ReLU epilogue into the
+conv — the entire point of the reference's cuDNN graph API.  So these are
+thin functionals that pin the fused *semantics* (epilogue order, NHWC
+layout, half-precision inputs allowed) rather than wrappers over a kernel.
+The reference casts inputs to fp16 via ``amp.custom_fwd``; here dtypes
+pass through, and the surrounding precision policy decides.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ConvBias", "ConvBiasMaskReLU", "ConvBiasReLU",
+           "ConvFrozenScaleBiasReLU"]
+
+_NHWC = ("NHWC", "HWIO", "NHWC")
+
+
+def _conv(x, weight, padding, stride):
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 2
+    elif padding and isinstance(padding[0], int):
+        padding = [(p, p) for p in padding]
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    return jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        dimension_numbers=_NHWC)
+
+
+def ConvBias(x, weight, bias, padding=0, stride=1):
+    """conv + bias (conv_bias_relu.py ConvBias_). x [N,H,W,Cin],
+    weight [kh,kw,Cin,Cout], bias [Cout]."""
+    return _conv(x, weight, padding, stride) + bias
+
+
+def ConvBiasReLU(x, weight, bias, padding=0, stride=1):
+    """conv + bias + ReLU (ConvBiasReLU_)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride))
+
+
+def ConvBiasMaskReLU(x, weight, bias, mask, padding=0, stride=1):
+    """conv + bias + elementwise mask + ReLU (ConvBiasMaskReLU_); the mask
+    multiplies the pre-activation (dropout/DropBlock-style)."""
+    return jax.nn.relu(ConvBias(x, weight, bias, padding, stride) * mask)
+
+
+def ConvFrozenScaleBiasReLU(x, weight, scale, bias, padding=0, stride=1):
+    """conv * scale + bias + ReLU with frozen (non-differentiated) scale and
+    bias — the folded-BN inference pattern (ConvFrozenScaleBiasReLU_)."""
+    scale = jax.lax.stop_gradient(scale)
+    bias = jax.lax.stop_gradient(bias)
+    return jax.nn.relu(_conv(x, weight, padding, stride) * scale + bias)

@@ -1,0 +1,6 @@
+from apex_tpu.contrib.cudnn_gbn.batch_norm import (
+    GroupBatchNorm2d,
+    bn_group_index_groups,
+)
+
+__all__ = ["GroupBatchNorm2d", "bn_group_index_groups"]

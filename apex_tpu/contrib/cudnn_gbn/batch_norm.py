@@ -1,0 +1,48 @@
+"""Group BatchNorm: batch-norm statistics reduced over rank *subgroups*.
+
+Parity target: ``apex.contrib.cudnn_gbn.GroupBatchNorm2d``
+(batch_norm.py:44-160 + csrc/cudnn_gbn/*): when per-rank batches are tiny
+(detection/segmentation), stats are shared across groups of ``group_size``
+adjacent ranks for a larger effective batch, without paying for a full
+world all-reduce.
+
+TPU design: the reference moves partial sums through peer-memory buffers
+between NVLink neighbors; on TPU the same communication pattern is one
+``psum`` with ``axis_index_groups`` — XLA lowers it to an ICI reduction
+within each subgroup (adjacent ranks on a TPU mesh axis are ICI
+neighbors, the analogous locality).  Everything else (Welford merge, fp32
+stats, running-stat updates) is shared with
+:class:`apex_tpu.parallel.SyncBatchNorm`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["GroupBatchNorm2d", "bn_group_index_groups"]
+
+
+def bn_group_index_groups(world_size: int, group_size: int):
+    """Partition ranks [0, world) into adjacent groups of ``group_size``
+    (batch_norm.py:145-155 builds the same peer groups from rank ids)."""
+    if group_size <= 1:
+        return None
+    if world_size % group_size != 0:
+        raise ValueError(
+            f"world_size ({world_size}) must be a multiple of "
+            f"group_size ({group_size})")
+    return [list(range(s, s + group_size))
+            for s in range(0, world_size, group_size)]
+
+
+class GroupBatchNorm2d(SyncBatchNorm):
+    """Channels-last BN whose stats reduce over ``group_size`` ranks.
+
+    Use ``GroupBatchNorm2d(num_features=C, axis_name='dp',
+    axis_index_groups=bn_group_index_groups(world, bn_group))``; with
+    ``axis_index_groups=None`` it degenerates to full SyncBatchNorm, with
+    ``axis_name=None`` to plain local BN (the reference's eval fallback).
+    ``axis_index_groups`` is inherited from :class:`SyncBatchNorm`.
+    """

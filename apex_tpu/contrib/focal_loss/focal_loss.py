@@ -35,9 +35,9 @@ def focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
     x = cls_output[..., :num_real_classes].astype(jnp.float32)
     targets = cls_targets_at_level.astype(jnp.int32)
 
-    # negative ids (ignore/background sentinels) -> no positive column
+    # negative ids (ignore/background sentinels) -> no positive column;
+    # one_hot already yields all-zero rows for out-of-range indices
     onehot = jax.nn.one_hot(targets, num_real_classes, dtype=jnp.float32)
-    onehot = jnp.where((targets >= 0)[..., None], onehot, 0.0)
     y = ((1.0 - label_smoothing) * onehot
          + label_smoothing / num_real_classes * jnp.ones_like(onehot)
          if label_smoothing else onehot)

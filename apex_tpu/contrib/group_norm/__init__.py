@@ -1,0 +1,3 @@
+from apex_tpu.contrib.group_norm.group_norm import GroupNorm, group_norm_nhwc
+
+__all__ = ["GroupNorm", "group_norm_nhwc"]

@@ -1,0 +1,98 @@
+"""Channels-last GroupNorm with optional fused Swish/SiLU.
+
+Parity target: ``apex.contrib.group_norm.GroupNorm``
+(group_norm.py:161-313 + csrc/group_norm/*.cu): NHWC group normalization
+with fp32 statistics and an optional ``act='swish'`` epilogue, used by
+diffusion UNets.
+
+TPU design: NHWC is already the native TPU layout, and XLA fuses
+normalize-scale-shift-swish chains into the surrounding kernel, so the
+one-pass/two-pass CUDA kernel split (a CUDA-SM occupancy trade-off,
+group_norm.py:289-297) has no analog here.  What the kernels *guarantee* —
+fp32 Welford statistics regardless of input dtype, channels-last reduction,
+swish fused into the epilogue, any (input dtype, param dtype) mix — is
+expressed directly: statistics are computed in fp32 over each (sample,
+group) slab and the result is cast back to the input dtype.
+
+The reference's SUPPORTED_CHANNELS table (group_norm.py:193-219) exists
+because hand-written kernels need C/G to divide CUDA tiles; XLA tiles any
+channel count, so every combination takes the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+__all__ = ["GroupNorm", "group_norm_nhwc"]
+
+_ACTS = {None: lambda x: x,
+         "": lambda x: x,
+         "silu": jax.nn.silu,
+         "swish": jax.nn.silu}
+
+
+def group_norm_nhwc(x, num_groups: int, weight=None, bias=None,
+                    eps: float = 1e-5, act: Optional[str] = None):
+    """GroupNorm over a channels-last tensor ``[N, ..., C]``.
+
+    Statistics are fp32 per (sample, group) over all spatial positions and
+    the group's channels; ``weight``/``bias`` are per-channel ``[C]``; the
+    optional swish/silu epilogue is applied after the affine transform.
+    """
+    if act not in _ACTS:
+        raise ValueError(f"unsupported act {act!r}; one of {sorted(map(str, _ACTS))}")
+    C = x.shape[-1]
+    if C % num_groups != 0:
+        raise ValueError(f"channels ({C}) not divisible by groups ({num_groups})")
+
+    orig_dtype = x.dtype
+    xg = x.astype(jnp.float32).reshape(*x.shape[:-1], num_groups, C // num_groups)
+    axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)  # spatial + in-group
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    y = (xg - mean) * jax.lax.rsqrt(var + eps)
+    y = y.reshape(x.shape)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return _ACTS[act](y).astype(orig_dtype)
+
+
+class GroupNorm(nn.Module):
+    """Module form of :func:`group_norm_nhwc` (group_norm.py:161-313).
+
+    Expects channels-last input (the TPU-native layout; the reference
+    requires ``memory_format=channels_last`` for its fast path too).
+    """
+
+    num_groups: int
+    num_channels: int
+    eps: float = 1e-5
+    affine: bool = True
+    act: Optional[str] = None
+    param_dtype: Any = jnp.float32
+
+    def setup(self):
+        if self.num_channels % self.num_groups != 0:
+            raise ValueError("num_channels must be divisible by num_groups")
+        if self.affine:
+            self.weight = self.param("weight", nn.initializers.ones,
+                                     (self.num_channels,), self.param_dtype)
+            self.bias = self.param("bias", nn.initializers.zeros,
+                                   (self.num_channels,), self.param_dtype)
+
+    def __call__(self, x):
+        if x.shape[-1] != self.num_channels:
+            raise ValueError(
+                f"expected channels-last input with C={self.num_channels}, "
+                f"got shape {x.shape}")
+        w = self.weight if self.affine else None
+        b = self.bias if self.affine else None
+        return group_norm_nhwc(x, self.num_groups, w, b, self.eps,
+                               self.act.lower() if self.act else self.act)

@@ -1,0 +1,71 @@
+"""Persistent NHWC BatchNorm with fused ReLU / add+ReLU epilogues.
+
+Parity target: ``apex.contrib.groupbn.BatchNorm2d_NHWC``
+(batch_norm.py:101-230 + csrc/groupbn/*, the "bnp" extension): NHWC BN
+with ``fuse_relu``, the ``bn_addrelu`` residual variant (``forward(x, z)``
+adds the skip tensor before ReLU), and cross-rank ``bn_group`` stats.
+
+TPU design: "persistent" CUDA kernels (one resident thread block per SM,
+spin-synced) are an occupancy technique with no TPU analog — XLA already
+emits a fused normalize/scale/shift/add/relu epilogue.  The CUDA launch
+tuning knobs (``max_cta_per_sm``, ``cta_launch_margin``, ``multi_stream``,
+magic buffers) are accepted and ignored.  ``bn_group`` maps to a psum over
+``axis_index_groups`` subgroups exactly like contrib.cudnn_gbn.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+from apex_tpu.contrib.cudnn_gbn.batch_norm import bn_group_index_groups
+from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+
+__all__ = ["BatchNorm2d_NHWC"]
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """NHWC BN; ``__call__(x, z=None)`` applies BN(x) (+ z) (+ ReLU).
+
+    ``bn_group > 1`` requires ``axis_name`` and a static ``world_size`` so
+    the rank subgroups can be formed at trace time.
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    fuse_relu: bool = False
+    bn_group: int = 1
+    axis_name: Optional[str] = None
+    world_size: Optional[int] = None
+    param_dtype: Any = None
+    # CUDA kernel-tuning knobs, accepted for API parity, no TPU meaning:
+    max_cta_per_sm: int = 2
+    cta_launch_margin: int = 12
+    multi_stream: bool = False
+
+    @nn.compact
+    def __call__(self, x, z=None, use_running_average: bool = False):
+        groups = None
+        if self.bn_group > 1:
+            if self.axis_name is None or self.world_size is None:
+                raise ValueError(
+                    "bn_group > 1 needs axis_name and world_size to form "
+                    "rank subgroups")
+            groups = bn_group_index_groups(self.world_size, self.bn_group)
+        bn_kwargs = {}
+        if self.param_dtype is not None:
+            bn_kwargs["param_dtype"] = self.param_dtype
+        bn = SyncBatchNorm(
+            num_features=self.num_features, eps=self.eps,
+            momentum=self.momentum, axis_name=self.axis_name,
+            axis_index_groups=groups, channel_axis=-1,
+            fuse_relu=self.fuse_relu and z is None, **bn_kwargs)
+        y = bn(x, use_running_average=use_running_average)
+        if z is not None:
+            # bn_addrelu: residual add happens before the ReLU epilogue
+            y = y + z
+            if self.fuse_relu:
+                y = nn.relu(y)
+        return y

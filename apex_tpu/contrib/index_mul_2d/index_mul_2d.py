@@ -1,0 +1,50 @@
+"""Fused gather-multiply: ``out[i] = in1[idx[i]] * in2[i]``.
+
+Parity target: ``apex.contrib.index_mul_2d``
+(index_mul_2d.py:5-120 + csrc/index_mul_2d/*): 2-D tensors, index along
+dim 0, fp32/fp16, with a hand-written backward (scatter-add into
+``grad_in1``, gather-multiply for ``grad_in2``).
+
+TPU design: expressed as ``take``·``multiply`` under a ``custom_vjp`` that
+pins the reference's backward (one ``segment_sum`` scatter-add, no
+materialized intermediate beyond what XLA fuses).  The CUDA kernel's win
+was avoiding a separate gather kernel; XLA fuses the gather into the
+multiply on TPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["index_mul_2d"]
+
+
+@jax.custom_vjp
+def index_mul_2d(in1, in2, idx1):
+    """in1 [S, H], in2 [N, H], idx1 [N] int -> [N, H]."""
+    return _check_and_mul(in1, in2, idx1)
+
+
+def _check_and_mul(in1, in2, idx1):
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise ValueError("in1 and in2 must be 2-D")
+    if idx1.ndim != 1 or in2.shape[0] != idx1.shape[0]:
+        raise ValueError("idx1 must be 1-D with len(idx1) == in2.shape[0]")
+    if in1.dtype != in2.dtype:
+        raise ValueError("in1 and in2 must share a dtype")
+    return jnp.take(in1, idx1, axis=0) * in2
+
+
+def _fwd(in1, in2, idx1):
+    return _check_and_mul(in1, in2, idx1), (in1, in2, idx1)
+
+
+def _bwd(residuals, g):
+    in1, in2, idx1 = residuals
+    grad_in1 = jax.ops.segment_sum(g * in2, idx1, num_segments=in1.shape[0])
+    grad_in2 = jnp.take(in1, idx1, axis=0) * g
+    return grad_in1.astype(in1.dtype), grad_in2.astype(in2.dtype), None
+
+
+index_mul_2d.defvjp(_fwd, _bwd)

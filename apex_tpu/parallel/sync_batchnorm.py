@@ -31,12 +31,17 @@ __all__ = ["SyncBatchNorm", "sync_batch_stats", "convert_syncbn_model"]
 
 
 def sync_batch_stats(x: jax.Array, channel_axis: int = -1,
-                     axis_name: Optional[str] = None):
+                     axis_name: Optional[str] = None,
+                     axis_index_groups=None):
     """(mean, var, count) of x over all non-channel dims and all ranks.
 
     The kernel path's welford_mean_var + welford_parallel
     (csrc/syncbn.cpp:99-100): locally-centered (mean, M2) per shard, one psum
     to merge.  Variance is biased (1/N), matching batch-norm semantics.
+
+    ``axis_index_groups`` restricts the reduction to rank subgroups — the
+    contrib GBN/bnp ``bn_group`` semantics (stats shared by groups of
+    ``bn_group`` adjacent ranks rather than the whole world).
     """
     x32 = x.astype(jnp.float32)
     axes = tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
@@ -54,7 +59,8 @@ def sync_batch_stats(x: jax.Array, channel_axis: int = -1,
     n_l = jnp.asarray(n_local, jnp.float32)
     if axis_name is not None:
         n, s1, m2, s2 = jax.lax.psum(
-            (n_l, n_l * mean_l, m2_l, n_l * jnp.square(mean_l)), axis_name)
+            (n_l, n_l * mean_l, m2_l, n_l * jnp.square(mean_l)), axis_name,
+            axis_index_groups=axis_index_groups)
     else:
         n, s1, m2, s2 = n_l, n_l * mean_l, m2_l, n_l * jnp.square(mean_l)
     mean = s1 / n
@@ -81,6 +87,7 @@ class SyncBatchNorm(nn.Module):
     use_bias: Optional[bool] = None  # default: affine
     track_running_stats: bool = True
     axis_name: Optional[str] = None
+    axis_index_groups: Optional[Any] = None  # rank subgroups (contrib GBN)
     channel_axis: int = -1
     fuse_relu: bool = False
     param_dtype: Any = jnp.float32
@@ -102,7 +109,8 @@ class SyncBatchNorm(nn.Module):
             # During init() the module runs outside any mapped axis context,
             # so the cross-rank reduction must be skipped.
             axis = None if self.is_initializing() else self.axis_name
-            mean, var, n = sync_batch_stats(x, ca, axis)
+            mean, var, n = sync_batch_stats(x, ca, axis,
+                                            self.axis_index_groups)
             if self.track_running_stats and not self.is_initializing():
                 m = self.momentum
                 # unbiased variance goes into the running buffer

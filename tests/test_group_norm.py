@@ -1,0 +1,75 @@
+"""contrib.group_norm vs torch.nn.functional.group_norm (the reference's
+fallback oracle, apex/contrib/group_norm/group_norm.py:138-147)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.group_norm import GroupNorm, group_norm_nhwc
+
+
+def torch_oracle(x_nhwc, G, weight, bias, eps, act):
+    import torch
+
+    x = torch.from_numpy(np.moveaxis(x_nhwc, -1, 1).copy())  # NHWC -> NCHW
+    y = torch.nn.functional.group_norm(
+        x, G, torch.from_numpy(weight), torch.from_numpy(bias), eps)
+    if act:
+        y = y * torch.sigmoid(y)
+    return np.moveaxis(y.numpy(), 1, -1)
+
+
+@pytest.mark.parametrize("act", [None, "swish"])
+@pytest.mark.parametrize("G,C", [(16, 128), (32, 320), (4, 20)])
+def test_group_norm_matches_torch(G, C, act):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, 7, C)).astype(np.float32)
+    w = rng.standard_normal(C).astype(np.float32)
+    b = rng.standard_normal(C).astype(np.float32)
+
+    got = group_norm_nhwc(jnp.asarray(x), G, jnp.asarray(w), jnp.asarray(b),
+                          1e-5, act)
+    np.testing.assert_allclose(got, torch_oracle(x, G, w, b, 1e-5, act),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_group_norm_bf16_input_fp32_stats():
+    rng = np.random.default_rng(1)
+    # large offset would break bf16-accumulated statistics
+    x = (rng.standard_normal((2, 4, 4, 64)) + 100.0).astype(np.float32)
+    w = np.ones(64, np.float32)
+    b = np.zeros(64, np.float32)
+    x_bf16 = jnp.asarray(x, jnp.bfloat16)
+    got = group_norm_nhwc(x_bf16, 8, jnp.asarray(w), jnp.asarray(b),
+                          1e-5, None)
+    assert got.dtype == jnp.bfloat16
+    # oracle on the SAME quantized input: the comparison then measures the
+    # statistics accumulation, not bf16 input rounding
+    want = torch_oracle(np.asarray(x_bf16, np.float32), 8, w, b, 1e-5, None)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=0.1, atol=0.1)
+    # normalized output: near-zero mean despite the +100 offset
+    assert abs(float(jnp.mean(got.astype(jnp.float32)))) < 0.05
+
+
+def test_group_norm_module_and_grad():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 3, 3, 32)), jnp.float32)
+    m = GroupNorm(num_groups=8, num_channels=32, act="silu")
+    params = m.init(jax.random.PRNGKey(0), x)
+
+    def loss(p, x):
+        return jnp.sum(m.apply(p, x) ** 2)
+
+    g = jax.grad(loss)(params, x)
+    leaves = jax.tree.leaves(g)
+    assert all(np.all(np.isfinite(l)) for l in leaves)
+    assert any(np.abs(l).max() > 0 for l in leaves)
+
+
+def test_group_norm_validation():
+    with pytest.raises(ValueError):
+        group_norm_nhwc(jnp.zeros((1, 2, 2, 10)), 3)
+    with pytest.raises(ValueError):
+        group_norm_nhwc(jnp.zeros((1, 2, 2, 8)), 2, act="relu")

@@ -31,12 +31,18 @@ from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
+from apex_tpu.amp import functional, lists
+from apex_tpu.amp.functional import active_policy, set_active_policy
 from apex_tpu.amp.policy import O0, O1, O2, O3, PrecisionPolicy, get_policy
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, static_loss_scaler
 
 __all__ = [
     "initialize",
     "AmpState",
+    "functional",
+    "lists",
+    "active_policy",
+    "set_active_policy",
     "PrecisionPolicy",
     "get_policy",
     "O0",
@@ -85,6 +91,9 @@ def initialize(
     if loss_scale is not None:
         overrides["loss_scale"] = loss_scale
     policy = get_policy(opt_level, half_dtype=half_dtype, **overrides)
+    # O1's patched-namespace semantics: ops called through amp.functional
+    # follow this policy's cast lists from now on
+    set_active_policy(policy)
     scaler = policy.make_scaler()
     return AmpState(
         apply=policy.wrap_apply(apply_fn),

@@ -1,0 +1,143 @@
+"""Policy-aware op namespace — the executable form of the O1 cast lists.
+
+Parity target: the patched namespaces of ``apex.amp``
+(amp/amp.py:73-183 ``wrap.cached_cast`` / ``wrap.promote`` /
+``wrap.sequence_promote``).  The reference mutates ``torch.*`` in place;
+mutating ``jax.numpy`` would break tracing and every other library, so the
+policy is scoped instead: ops are used through this module
+(``from apex_tpu.amp import functional as F; F.matmul(a, b)``) and consult
+the *active policy* installed by :func:`apex_tpu.amp.initialize` or the
+:func:`active_policy` context manager.  With no active policy (or O0)
+every wrapper is an exact pass-through.
+
+The three wrap rules:
+- half ops   -> float inputs cast to ``policy.compute_dtype``
+- float ops  -> float inputs cast to fp32
+- promote ops / sequences -> all float inputs cast to the widest
+  participating float dtype (fp32 wins over half; bf16 and fp16 both
+  count as "narrow")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import sys
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists as _lists
+
+_state = threading.local()   # per-thread override (the context manager)
+_default_policy = None       # process-wide default (amp.initialize)
+_UNSET = object()
+
+
+def _current():
+    thread_local = getattr(_state, "policy", _UNSET)
+    return _default_policy if thread_local is _UNSET else thread_local
+
+
+@contextlib.contextmanager
+def active_policy(policy):
+    """Scope a PrecisionPolicy over ops called through this module (this
+    thread only)."""
+    prev = getattr(_state, "policy", _UNSET)
+    _state.policy = policy
+    try:
+        yield
+    finally:
+        if prev is _UNSET:
+            del _state.policy
+        else:
+            _state.policy = prev
+
+
+def set_active_policy(policy) -> None:
+    """Install a policy process-wide, visible from every thread (the
+    ``amp.initialize`` analog)."""
+    global _default_policy
+    _default_policy = policy
+
+
+def _is_float_array(x) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        jnp.result_type(x), jnp.floating)
+
+
+def _cast_tree(tree, dtype):
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if _is_float_array(x) else x, tree)
+
+
+def widest_dtype(*arrays) -> Optional[Any]:
+    """fp32 if any float input is fp32, else the (common) half dtype."""
+    dtypes = [jnp.result_type(a) for a in jax.tree.leaves(arrays)
+              if _is_float_array(a)]
+    if not dtypes:
+        return None
+    # jnp's lattice: same-half stays narrow, fp16+bf16 and half+fp32 -> fp32
+    return jnp.result_type(*dtypes)
+
+
+def _resolve(name: str):
+    """Find the op in jnp / jax.nn / jax.lax / jnp.linalg (first match)."""
+    for ns in (jnp, jax.nn, jax.lax, jnp.linalg):
+        obj = ns
+        found = True
+        for part in name.split("."):
+            if not hasattr(obj, part):
+                found = False
+                break
+            obj = getattr(obj, part)
+        if found and callable(obj):
+            return obj
+    raise AttributeError(f"no jax op named {name!r}")
+
+
+def _wrap(name: str, rule: str):
+    fn = _resolve(name)
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        policy = _current()
+        # only O1 patches functions (frontend.py patch_torch_functions is
+        # False for O0/O2/O3 — O3's "no fp32 exemptions" depends on it)
+        if policy is None or policy.opt_level != "O1":
+            return fn(*args, **kwargs)
+        if rule == "half":
+            args = _cast_tree(args, policy.compute_dtype)
+        elif rule == "float":
+            args = _cast_tree(args, jnp.float32)
+        elif rule == "promote":
+            target = widest_dtype(*args)
+            if target is not None:
+                args = _cast_tree(args, target)
+        elif rule == "sequence":
+            seq = args[0]
+            target = widest_dtype(*seq)
+            if target is not None:
+                args = (_cast_tree(tuple(seq), target),) + args[1:]
+        return fn(*args, **kwargs)
+
+    wrapped.__amp_rule__ = rule
+    return wrapped
+
+
+_module = sys.modules[__name__]
+for _name in _lists.HALF_FUNCS:
+    setattr(_module, _name.replace(".", "_"), _wrap(_name, "half"))
+for _name in _lists.FLOAT_FUNCS:
+    setattr(_module, _name.replace(".", "_"), _wrap(_name, "float"))
+for _name in _lists.PROMOTE_FUNCS:
+    setattr(_module, _name.replace(".", "_"), _wrap(_name, "promote"))
+for _name in _lists.SEQUENCE_FUNCS:
+    setattr(_module, _name.replace(".", "_"), _wrap(_name, "sequence"))
+
+__all__ = (["active_policy", "set_active_policy", "widest_dtype"]
+           + [n.replace(".", "_") for n in
+              _lists.HALF_FUNCS + _lists.FLOAT_FUNCS
+              + _lists.PROMOTE_FUNCS + _lists.SEQUENCE_FUNCS])

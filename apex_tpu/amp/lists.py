@@ -1,0 +1,58 @@
+"""The amp O1 cast-list contract as data.
+
+Parity target: ``apex.amp.lists`` (torch_overrides.py:7-112,
+functional_overrides.py, tensor_overrides.py — ~2.9k LoC of op
+classification) and the promotion engine (``apex/amp/amp.py:73-183``).
+
+The reference expresses O1 by monkey-patching every listed torch function;
+the *behavioral contract* underneath is three rules, which is what this
+module encodes for JAX ops:
+
+- **HALF ops** (tensor-core / MXU beneficiaries): inputs cast to the
+  policy's half dtype before the op.
+- **FLOAT ops** (numerically sensitive: transcendentals, reductions,
+  norms, losses): inputs cast to fp32.
+- **PROMOTE ops** (multi-array math): all array inputs cast to the widest
+  participating float dtype ("widest wins", amp.py promote_match_arg0);
+  comparisons follow the same rule.
+- **SEQUENCE ops** (cat/stack): the whole sequence is cast to its widest
+  member (amp.py sequence_promote).
+
+Names refer to ``jax.numpy`` / ``jax.lax`` / ``jax.nn`` functions; the
+dispatcher in :mod:`apex_tpu.amp.functional` wraps exactly these.
+"""
+
+from __future__ import annotations
+
+# MXU-bound ops: run in half under O1 (torch_overrides.FP16_FUNCS:7-27)
+HALF_FUNCS = [
+    "matmul", "dot", "tensordot", "einsum", "vdot", "inner", "outer",
+    # lax conv family (conv1d/2d/3d/transpose in the reference)
+    "conv_general_dilated", "conv", "conv_transpose",
+]
+
+# numerically-sensitive ops: run in fp32 under O1
+# (torch_overrides.FP32_FUNCS:29-61 + functional_overrides losses/norms)
+FLOAT_FUNCS = [
+    # pointwise transcendentals
+    "acos", "asin", "cosh", "sinh", "tan", "exp", "expm1",
+    "log", "log10", "log2", "log1p", "reciprocal", "rsqrt", "power",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "cumsum", "cumprod",
+    "linalg.norm", "logsumexp",
+    # softmax/loss family (functional_overrides.FP32_FUNCS)
+    "softmax", "log_softmax", "softplus",
+]
+
+# multi-array math: promote to the widest float dtype
+# (torch_overrides.CASTS:86-108)
+PROMOTE_FUNCS = [
+    "add", "subtract", "multiply", "divide", "true_divide",
+    "arctan2", "cross", "hypot",
+    # comparisons promote their operands the same way
+    "equal", "not_equal", "greater", "greater_equal", "less", "less_equal",
+]
+
+# sequence ops: cast all members to the widest member
+# (torch_overrides.SEQUENCE_CASTS:110-112)
+SEQUENCE_FUNCS = ["concatenate", "stack", "hstack", "vstack"]

@@ -17,7 +17,6 @@ pass through, and the surrounding precision policy decides.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 __all__ = ["ConvBias", "ConvBiasMaskReLU", "ConvBiasReLU",
            "ConvFrozenScaleBiasReLU"]

@@ -17,8 +17,6 @@ stats, running-stat updates) is shared with
 
 from __future__ import annotations
 
-from typing import Any, Optional
-
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 __all__ = ["GroupBatchNorm2d", "bn_group_index_groups"]

@@ -64,8 +64,7 @@ class BatchNorm2d_NHWC(nn.Module):
             fuse_relu=self.fuse_relu and z is None, **bn_kwargs)
         y = bn(x, use_running_average=use_running_average)
         if z is not None:
-            # bn_addrelu: residual add happens before the ReLU epilogue
-            y = y + z
-            if self.fuse_relu:
-                y = nn.relu(y)
+            # bn_addrelu: passing z selects the add+ReLU kernel in the
+            # reference, which ALWAYS applies ReLU regardless of fuse_relu
+            y = nn.relu(y + z)
         return y

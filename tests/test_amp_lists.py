@@ -1,0 +1,134 @@
+"""amp cast-list contract tests — port of the reference's L0/run_amp
+behavioral suite (test_basic_casts.py, test_promotion.py) to the
+policy-scoped functional namespace."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.amp import functional as F
+from apex_tpu.amp.policy import get_policy
+
+HALF = jnp.bfloat16
+DTYPES = [jnp.float16, jnp.bfloat16, jnp.float32]
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_policy():
+    # amp.initialize installs a process-wide policy; isolate from any test
+    # that ran it earlier
+    F.set_active_policy(None)
+    yield
+    F.set_active_policy(None)
+
+
+def o1():
+    return F.active_policy(get_policy("O1", half_dtype=HALF))
+
+
+# --- basic casts (test_basic_casts.py run_layer_test semantics) ------------
+
+def test_matmul_casts_to_half_under_o1():
+    x = jnp.ones((4, 4), jnp.float32)
+    with o1():
+        assert F.matmul(x, x).dtype == HALF
+        assert F.einsum("ij,jk->ik", x, x).dtype == HALF
+
+
+def test_float_funcs_cast_to_fp32_under_o1():
+    x = jnp.ones((8,), HALF)
+    with o1():
+        assert F.exp(x).dtype == jnp.float32
+        assert F.sum(x).dtype == jnp.float32
+        assert F.softmax(x).dtype == jnp.float32
+        assert F.linalg_norm(x).dtype == jnp.float32
+
+
+def test_no_policy_is_passthrough():
+    x = jnp.ones((4, 4), jnp.float32)
+    assert F.matmul(x, x).dtype == jnp.float32
+    h = jnp.ones((8,), HALF)
+    assert F.exp(h).dtype == HALF
+
+
+def test_o0_is_passthrough():
+    x = jnp.ones((4, 4), jnp.float32)
+    with F.active_policy(get_policy("O0")):
+        assert F.matmul(x, x).dtype == jnp.float32
+
+
+# --- promotion (test_promotion.py semantics) -------------------------------
+
+@pytest.mark.parametrize("fn_name", ["multiply", "add", "divide", "arctan2"])
+def test_binary_promote_matches_widest(fn_name):
+    fn = getattr(F, fn_name)
+    with o1():
+        for xt, yt in itertools.product(DTYPES, DTYPES):
+            out = fn(jnp.ones((4,), xt), jnp.ones((4,), yt))
+            if xt == yt and xt != jnp.float32:
+                # matching halves stay narrow (no silent fp32 upgrade)
+                assert out.dtype == xt, (xt, yt)
+            elif xt == jnp.float32 or yt == jnp.float32 or xt != yt:
+                # widest wins; fp16+bf16 has no common half -> fp32
+                assert out.dtype == jnp.float32, (xt, yt)
+
+
+def test_comparison_promotes_operands():
+    with o1():
+        out = F.greater(jnp.ones((4,), HALF), jnp.ones((4,), jnp.float32))
+        assert out.dtype == jnp.bool_  # comparison result; no dtype error
+
+
+def test_sequence_cast_widest():
+    with o1():
+        a = jnp.ones((2, 2), HALF)
+        b = jnp.ones((2, 2), jnp.float32)
+        assert F.concatenate([a, b]).dtype == jnp.float32
+        assert F.stack([a, a]).dtype == HALF
+
+
+def test_grad_dtype_preserved_through_half_matmul():
+    # test_promotion.py: x_leaf.grad.dtype == xtype — the cotangent wrt an
+    # fp32 leaf must come back fp32 even when the op ran in half
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def loss(x):
+        with o1():
+            return F.matmul(x, x).astype(jnp.float32).sum()
+
+    g = jax.grad(loss)(x)
+    assert g.dtype == jnp.float32
+
+
+# --- policy coherence (frontend.py O-level properties) ---------------------
+
+def test_policy_properties_match_reference_table():
+    o0, o1p, o2, o3 = (get_policy(l, half_dtype=jnp.float16)
+                       for l in ("O0", "O1", "O2", "O3"))
+    # frontend.py: O0 fp32 everything, no scaling
+    assert o0.param_dtype == jnp.float32 and o0.loss_scale is None
+    # O1: fp32 params, half compute, dynamic scale (fp16)
+    assert o1p.param_dtype == jnp.float32
+    assert o1p.compute_dtype == jnp.float16
+    assert o1p.loss_scale == "dynamic"
+    # O2: half params, master weights, keeps norms fp32
+    assert o2.param_dtype == jnp.float16 and o2.master_weights
+    assert o2.keep_norm_fp32
+    # O3: pure half, no exemptions
+    assert o3.param_dtype == jnp.float16 and not o3.keep_norm_fp32
+
+
+def test_lists_cover_reference_categories():
+    from apex_tpu.amp import lists
+
+    # spot-pin the load-bearing classifications
+    assert "matmul" in lists.HALF_FUNCS
+    assert "conv_general_dilated" in lists.HALF_FUNCS
+    for name in ("exp", "log", "sum", "softmax", "rsqrt"):
+        assert name in lists.FLOAT_FUNCS
+    for name in ("add", "multiply", "arctan2"):
+        assert name in lists.PROMOTE_FUNCS
+    assert "concatenate" in lists.SEQUENCE_FUNCS

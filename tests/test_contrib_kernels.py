@@ -163,6 +163,13 @@ def test_batchnorm_nhwc_addrelu():
     want = np.maximum(_bn_oracle(np.asarray(x)) + np.asarray(z), 0.0)
     np.testing.assert_allclose(y, want, rtol=2e-3, atol=2e-3)
 
+    # passing z selects the reference's bn_addrelu kernel, which applies
+    # ReLU even with fuse_relu=False
+    bn2 = BatchNorm2d_NHWC(num_features=8, fuse_relu=False)
+    y2, _ = bn2.apply(bn2.init(jax.random.PRNGKey(0), x), x, z,
+                      mutable=["batch_stats"])
+    np.testing.assert_allclose(y2, want, rtol=2e-3, atol=2e-3)
+
 
 def test_bn_group_index_groups_validation():
     from apex_tpu.contrib.cudnn_gbn import bn_group_index_groups

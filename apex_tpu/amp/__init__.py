@@ -92,8 +92,11 @@ def initialize(
         overrides["loss_scale"] = loss_scale
     policy = get_policy(opt_level, half_dtype=half_dtype, **overrides)
     # O1's patched-namespace semantics: ops called through amp.functional
-    # follow this policy's cast lists from now on
-    set_active_policy(policy)
+    # follow this policy's cast lists from now on.  Other levels don't
+    # patch (frontend.py patch_torch_functions=False) — and must not
+    # clobber an O1 policy installed by an earlier initialize.
+    if opt_level == "O1":
+        set_active_policy(policy)
     scaler = policy.make_scaler()
     return AmpState(
         apply=policy.wrap_apply(apply_fn),

@@ -109,18 +109,26 @@ def _wrap(name: str, rule: str):
         if policy is None or policy.opt_level != "O1":
             return fn(*args, **kwargs)
         if rule == "half":
-            args = _cast_tree(args, policy.compute_dtype)
+            args, kwargs = _cast_tree((args, kwargs), policy.compute_dtype)
         elif rule == "float":
-            args = _cast_tree(args, jnp.float32)
+            args, kwargs = _cast_tree((args, kwargs), jnp.float32)
         elif rule == "promote":
-            target = widest_dtype(*args)
+            target = widest_dtype(args, kwargs)
             if target is not None:
-                args = _cast_tree(args, target)
+                args, kwargs = _cast_tree((args, kwargs), target)
         elif rule == "sequence":
-            seq = args[0]
-            target = widest_dtype(*seq)
-            if target is not None:
-                args = (_cast_tree(tuple(seq), target),) + args[1:]
+            # the sequence may arrive positionally or by keyword
+            if args:
+                seq, rest = args[0], args[1:]
+                target = widest_dtype(seq)
+                if target is not None:
+                    args = (_cast_tree(tuple(seq), target),) + rest
+            else:
+                key = next(iter(kwargs))
+                target = widest_dtype(kwargs[key])
+                if target is not None:
+                    kwargs = {**kwargs,
+                              key: _cast_tree(tuple(kwargs[key]), target)}
         return fn(*args, **kwargs)
 
     wrapped.__amp_rule__ = rule

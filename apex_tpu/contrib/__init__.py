@@ -16,11 +16,13 @@ _SUBMODULES = (
     "cudnn_gbn",
     "fmha",
     "focal_loss",
+    "halo",
     "group_norm",
     "groupbn",
     "index_mul_2d",
     "multihead_attn",
     "optimizers",
+    "sparsity",
     "transducer",
     "xentropy",
 )

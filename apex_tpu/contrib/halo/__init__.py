@@ -1,0 +1,11 @@
+from apex_tpu.contrib.halo.halo_exchange import (
+    HaloExchanger1d,
+    halo_exchange_1d,
+    left_right_halo_exchange,
+    spatial_conv2d,
+)
+from apex_tpu.contrib.halo.bottleneck import SpatialBottleneck
+
+__all__ = ["HaloExchanger1d", "halo_exchange_1d",
+           "left_right_halo_exchange", "spatial_conv2d",
+           "SpatialBottleneck"]

@@ -1,0 +1,4 @@
+from apex_tpu.contrib.sparsity.asp import ASP
+from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
+
+__all__ = ["ASP", "create_mask"]

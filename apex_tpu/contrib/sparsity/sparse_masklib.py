@@ -1,0 +1,71 @@
+"""N:M structured-sparsity mask calculation.
+
+Parity target: ``apex.contrib.sparsity.sparse_masklib``
+(sparse_masklib.py:9-183): given a weight tensor and a pattern string like
+``"m4n2_1d"``, return a boolean mask keeping the n largest-magnitude
+entries of every group of m along the reduction dimension — the 2:4
+pattern Sparse Tensor Cores consume.
+
+TPU design: the pattern search is the reference's exact algorithm
+(enumerate all C(m, n) group patterns, pick the argmax of |w|·pattern per
+group, sparse_masklib.py mn_1d_best:37-47) but fully vectorized: one
+[groups, patterns] matmul + argmax instead of a per-row loop.  Groups run
+along the *reduction* axis, which for JAX layouts (Dense ``[in, out]``,
+conv ``HWIO``) is axis -2 — the transposed equivalent of the reference
+pruning torch's ``[out, in]`` rows along ``in``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+from functools import lru_cache
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["create_mask", "mn_1d_best", "compute_valid_1d_patterns"]
+
+
+@lru_cache(maxsize=None)
+def compute_valid_1d_patterns(m: int, n: int) -> np.ndarray:
+    """All C(m, n) binary patterns with n ones (sparse_masklib.py:25-35)."""
+    patterns = [
+        [1.0 if i in keep else 0.0 for i in range(m)]
+        for keep in itertools.combinations(range(m), n)
+    ]
+    return np.asarray(patterns, np.float32)  # [C(m,n), m]
+
+
+def mn_1d_best(matrix, m: int, n: int):
+    """Best n:m mask per m-group along the last axis (mn_1d_best:37-47)."""
+    if matrix.shape[-1] % m:
+        raise ValueError(
+            f"last dim ({matrix.shape[-1]}) must be a multiple of m={m}")
+    patterns = jnp.asarray(compute_valid_1d_patterns(m, n))   # [P, m]
+    groups = jnp.abs(matrix.astype(jnp.float32)).reshape(-1, m)
+    scores = groups @ patterns.T                              # [G, P]
+    best = jnp.argmax(scores, axis=-1)
+    return jnp.take(patterns, best, axis=0).reshape(matrix.shape) > 0.5
+
+
+_PATTERN_RE = re.compile(r"m(\d+)n(\d+)_1d")
+
+
+def create_mask(tensor, pattern: str = "m4n2_1d", axis: int = -2):
+    """Boolean keep-mask for ``tensor`` under an ``mMnN_1d`` pattern.
+
+    ``axis`` is the reduction dimension to group along (default -2: the
+    ``in`` dim of Dense ``[in, out]`` kernels and the ``I`` of conv
+    ``HWIO``); 1-D tensors group along their only axis.
+    """
+    match = _PATTERN_RE.fullmatch(pattern)
+    if not match:
+        raise ValueError(f"unsupported sparsity pattern {pattern!r} "
+                         "(expected 'mMnN_1d', e.g. 'm4n2_1d')")
+    m, n = int(match.group(1)), int(match.group(2))
+    if tensor.ndim == 1:
+        return mn_1d_best(tensor, m, n)
+    moved = jnp.moveaxis(tensor, axis, -1)
+    mask = mn_1d_best(moved, m, n)
+    return jnp.moveaxis(mask, -1, axis)

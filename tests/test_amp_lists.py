@@ -90,6 +90,29 @@ def test_sequence_cast_widest():
         assert F.stack([a, a]).dtype == HALF
 
 
+def test_kwargs_follow_cast_rules():
+    x = jnp.ones((4, 4), jnp.float32)
+    h = jnp.ones((8,), HALF)
+    with o1():
+        # keyword args must be cast exactly like positional ones
+        assert F.matmul(x, b=x).dtype == HALF
+        assert F.softmax(x=h).dtype == jnp.float32
+        assert F.concatenate(arrays=[h, jnp.ones((8,), jnp.float32)]).dtype \
+            == jnp.float32
+
+
+def test_later_non_o1_initialize_keeps_o1_policy():
+    import apex_tpu.amp as amp
+
+    x = jnp.ones((4, 4), jnp.float32)
+    amp.initialize(lambda p, a: a, {}, opt_level="O1", half_dtype=HALF)
+    try:
+        amp.initialize(lambda p, a: a, {}, opt_level="O2")
+        assert F.matmul(x, x).dtype == HALF  # O1 policy survived
+    finally:
+        F.set_active_policy(None)
+
+
 def test_grad_dtype_preserved_through_half_matmul():
     # test_promotion.py: x_leaf.grad.dtype == xtype — the cotangent wrt an
     # fp32 leaf must come back fp32 even when the op ran in half

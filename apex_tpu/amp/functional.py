@@ -117,18 +117,20 @@ def _wrap(name: str, rule: str):
             if target is not None:
                 args, kwargs = _cast_tree((args, kwargs), target)
         elif rule == "sequence":
-            # the sequence may arrive positionally or by keyword
+            # the sequence may arrive positionally or by keyword; find the
+            # first argument that actually holds float arrays
             if args:
                 seq, rest = args[0], args[1:]
                 target = widest_dtype(seq)
                 if target is not None:
                     args = (_cast_tree(tuple(seq), target),) + rest
             else:
-                key = next(iter(kwargs))
-                target = widest_dtype(kwargs[key])
-                if target is not None:
-                    kwargs = {**kwargs,
-                              key: _cast_tree(tuple(kwargs[key]), target)}
+                for key, value in kwargs.items():
+                    target = widest_dtype(value)
+                    if target is not None:
+                        kwargs = {**kwargs,
+                                  key: _cast_tree(tuple(value), target)}
+                        break
         return fn(*args, **kwargs)
 
     wrapped.__amp_rule__ = rule

@@ -50,7 +50,6 @@ class ASP:
     _masks: Optional[Dict[str, Any]] = None
     _pruned: Optional[Dict[str, Any]] = None
     _calculate_mask: Optional[Callable] = None
-    _eligible: Optional[Callable] = None
     _pattern: Optional[str] = None
     _allow_recompute = False
     _verbosity = 0
@@ -97,7 +96,6 @@ class ASP:
                     and jnp.issubdtype(leaf.dtype, jnp.floating)
                     and leaf.shape[-2] % 16 == 0 and leaf.shape[-1] % 8 == 0)
 
-        cls._eligible = eligible
         cls._masks = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
             name = _leaf_name(path)
@@ -205,13 +203,9 @@ class ASP:
         if cls._pattern is not None:
             pattern = cls._pattern
             cls._calculate_mask = lambda p: create_mask(p, pattern)
-        if cls._eligible is None and cls._masks is not None:
-            # restored masks define eligibility exactly
-            names = set(cls._masks)
-            cls._eligible = lambda name, leaf: name in names
 
     @classmethod
     def reset(cls):
         """Testing hook: drop all singleton state."""
         cls._masks = cls._pruned = None
-        cls._calculate_mask = cls._eligible = cls._pattern = None
+        cls._calculate_mask = cls._pattern = None

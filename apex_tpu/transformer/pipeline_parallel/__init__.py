@@ -8,6 +8,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     PipelineStageSpec,
     build_model,
     forward_backward_no_pipelining,
+    forward_backward_pipelining_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
@@ -24,6 +25,7 @@ __all__ = [
     "PipelineStageSpec",
     "build_model",
     "forward_backward_no_pipelining",
+    "forward_backward_pipelining_1f1b",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
     "get_forward_backward_func",

@@ -4,6 +4,9 @@ from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineStageSpec,
     build_model,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_1f1b import (
+    forward_backward_pipelining_1f1b,
+)
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (
     forward_backward_no_pipelining,
 )
@@ -19,6 +22,7 @@ __all__ = [
     "PipelineStageSpec",
     "build_model",
     "forward_backward_no_pipelining",
+    "forward_backward_pipelining_1f1b",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
     "pipeline_loss",
@@ -28,9 +32,15 @@ __all__ = [
 
 def get_forward_backward_func(virtual_pipeline_model_parallel_size,
                               pipeline_model_parallel_size):
-    """schedules/__init__.py get_forward_backward_func parity."""
+    """schedules/__init__.py get_forward_backward_func parity.
+
+    The non-interleaved choice is the true-1F1B schedule (O(pp)-bounded
+    activation memory, like the reference's); the autodiff two-sweep
+    remains available directly as
+    ``forward_backward_pipelining_without_interleaving``.
+    """
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
             return forward_backward_pipelining_with_interleaving
-        return forward_backward_pipelining_without_interleaving
+        return forward_backward_pipelining_1f1b
     return forward_backward_no_pipelining

@@ -1,0 +1,184 @@
+"""True 1F1B pipeline schedule with O(pp)-bounded activation memory.
+
+Parity target: ``forward_backward_pipelining_without_interleaving``
+(fwd_bwd_pipelining_without_interleaving.py:241-520) — the point of 1F1B
+over GPipe is the *memory bound*: each stage holds at most O(pp) in-flight
+microbatches, not O(num_microbatches).
+
+TPU design: JAX's autodiff-of-scan (the two-sweep schedule in
+:mod:`.fwd_bwd_pipelining_without_interleaving`) stacks one residual per
+tick, which reproduces GPipe's memory profile.  To get the 1F1B bound the
+backward must be scheduled *manually*: this module runs one ``lax.scan``
+over ``num_micro + 2*(pp-1)`` ticks whose carry is
+
+- the forward wire (activations moving rank r -> r+1),
+- the backward wire (cotangents moving rank r -> r-1),
+- a circular buffer of the last ``2*pp - 1`` stage *inputs* (the only
+  thing 1F1B-with-recompute keeps alive per in-flight microbatch),
+- the gradient accumulator and loss accumulator.
+
+Per tick, rank r forwards microbatch ``f = t - r`` and backwards
+microbatch ``b = t - 2*(pp-1) + r`` (the classic 1F1B timetable: the last
+stage backwards a microbatch the same tick it forwards it).  The backward
+is an in-tick ``jax.vjp`` over the stage, recomputing the stage forward
+from the saved input — i.e. the reference's activation-checkpointing mode
+(``jax.checkpoint`` granularity = whole stage); residuals never cross tick
+boundaries, so the scan carries no stacked activations.  Because every
+saved buffer lives in the fixed-size carry, peak memory is flat in
+``num_microbatches`` — asserted by ``tests/test_pipeline_parallel.py``
+via XLA's compiled memory analysis.
+
+The partial-activation-checkpoint window (reference :351-361) trades this
+recompute for memory on a prefix of microbatches; with whole-stage
+recompute the equivalent knob is per-layer ``jax.checkpoint`` policies
+*inside* ``stage_fn`` (e.g. ``checkpoint_dots``) — finer-grained than the
+reference's window and compiler-schedulable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    _index_mb,
+    pipeline_loss,
+)
+
+__all__ = ["forward_backward_pipelining_1f1b"]
+
+
+def forward_backward_pipelining_1f1b(
+    spec,
+    params: Any,
+    batches: Any,
+    *,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    grad_scaler=None,
+    scaler_state=None,
+    # reference-API compat; static shapes make these meaningless here
+    checkpoint_stages: bool = True,
+    tensor_shape=None,
+    dtype=None,
+    disable_autocast: bool = False,
+    deallocate_pipeline_outputs: bool = False,
+) -> Tuple[jax.Array, Optional[Any]]:
+    """Returns (mean loss on all ranks, per-rank stage grads), matching
+    :func:`forward_backward_pipelining_without_interleaving` numerics with
+    a 1F1B memory profile.  Grads come back scaled when a scaler is given.
+    """
+    del checkpoint_stages, tensor_shape, dtype, disable_autocast
+    del deallocate_pipeline_outputs
+    if forward_only:
+        # an undifferentiated forward scan saves no residuals, so the
+        # two-sweep loss is already memory-bounded here
+        local = pipeline_loss(spec, params, batches, axis_name=axis_name)
+        return jax.lax.psum(local, axis_name), None
+    n_micro = jax.tree.leaves(batches)[0].shape[0]
+    p = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    scale = jnp.float32(1.0)
+    if grad_scaler is not None and scaler_state is not None:
+        scale = scaler_state.scale
+
+    def full(prm, x_wire, mb):
+        """Uniform per-rank stage program: inject -> stage -> head/loss.
+
+        Differentiating this one function wrt (prm, x_wire) yields every
+        backward path at once: stage grads everywhere, embedding
+        (first_fn) grads where rank 0, head/loss grads where last rank.
+        """
+        inj = spec.first_fn(prm, mb)
+        x = jax.tree.map(lambda a, b: jnp.where(rank == 0, a, b), inj, x_wire)
+        y = spec.stage_fn(prm, x)
+        loss = spec.last_fn(prm, y, mb)
+        return y, loss
+
+    # wire template + fixed-size in-flight input buffer (2p-1 slots: a
+    # microbatch is in flight at stage r for 2*(p-1-r) ticks, < 2p-1)
+    wire0 = spec.first_fn(params, _index_mb(batches, 0))
+    wire_zero = jax.tree.map(jnp.zeros_like, wire0)
+    k_slots = 2 * p - 1
+
+    def buf_like(w):
+        return jax.tree.map(
+            lambda l: jnp.zeros((k_slots,) + l.shape, l.dtype), w)
+
+    fwd_perm = [(i, i + 1) for i in range(p - 1)]
+    bwd_perm = [(i + 1, i) for i in range(p - 1)]
+
+    carry0 = dict(
+        fwd_wire=wire_zero,
+        bwd_wire=wire_zero,
+        xbuf=buf_like(wire_zero),
+        grads=jax.tree.map(jnp.zeros_like, params),
+        loss=jnp.float32(0.0),
+    )
+
+    def tick(c, t):
+        f = t - rank                          # microbatch to forward
+        b = t - 2 * (p - 1) + rank            # microbatch to backward
+        active_f = jnp.logical_and(f >= 0, f < n_micro)
+        active_b = jnp.logical_and(b >= 0, b < n_micro)
+
+        # ---- forward: run the stage, bank the wire input, count the loss
+        y, loss_f = full(params, c["fwd_wire"], _index_mb(batches, f))
+        slot_f = jnp.where(active_f, f % k_slots, 0)
+        xbuf = jax.tree.map(
+            lambda buf, w: jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(
+                    active_f,
+                    w.astype(buf.dtype),
+                    jax.lax.dynamic_index_in_dim(buf, slot_f, 0, False)),
+                slot_f, 0),
+            c["xbuf"], c["fwd_wire"])
+        loss = c["loss"] + jnp.where(
+            jnp.logical_and(rank == p - 1, active_f),
+            loss_f.astype(jnp.float32), 0.0)
+
+        # ---- backward: recompute mb b's stage from its banked input and
+        # pull cotangents through it (whole-stage remat, in-tick residuals)
+        slot_b = jnp.where(active_b, b % k_slots, 0)
+        x_saved = jax.tree.map(
+            lambda buf, w: jax.lax.dynamic_index_in_dim(
+                buf, slot_b, 0, False).astype(w.dtype),
+            xbuf, c["fwd_wire"])
+        mb_b = _index_mb(batches, b)
+        _, vjp_fn = jax.vjp(lambda prm, x: full(prm, x, mb_b), params,
+                            x_saved)
+        # cotangents (dtypes must match the primal outputs exactly):
+        # non-last ranks pull the wire cotangent, the last rank seeds the
+        # loss cotangent; both masked off for not-in-flight microbatches
+        use_wire = jnp.logical_and(active_b, rank != p - 1)
+        dy = jax.tree.map(
+            lambda w: jnp.where(use_wire, w, jnp.zeros_like(w)),
+            c["bwd_wire"])
+        dloss = jnp.where(jnp.logical_and(rank == p - 1, active_b),
+                          scale / n_micro, 0.0).astype(loss_f.dtype)
+        dparams, dx = vjp_fn((dy, dloss))
+        grads = jax.tree.map(
+            lambda g, d: g + jnp.where(active_b, d, jnp.zeros_like(d)
+                                       ).astype(g.dtype),
+            c["grads"], dparams)
+
+        # ---- move both wires one hop (forward up, cotangents down)
+        new_c = dict(
+            fwd_wire=jax.tree.map(
+                lambda l: jax.lax.ppermute(l, axis_name, fwd_perm), y),
+            bwd_wire=jax.tree.map(
+                lambda l: jax.lax.ppermute(l, axis_name, bwd_perm), dx),
+            xbuf=xbuf,
+            grads=grads,
+            loss=loss,
+        )
+        return new_c, None
+
+    total_ticks = n_micro + 2 * (p - 1)
+    final, _ = jax.lax.scan(tick, carry0, jnp.arange(total_ticks))
+
+    loss = jax.lax.psum(final["loss"], axis_name) / n_micro
+    return loss, final["grads"]

@@ -18,6 +18,7 @@ from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.pipeline_parallel import (
     PipelineStageSpec,
     forward_backward_no_pipelining,
+    forward_backward_pipelining_1f1b,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
@@ -102,6 +103,73 @@ def test_pipeline_matches_sequential(pp4_mesh, rng, n_micro):
                                rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("n_micro", [4, 7])
+def test_1f1b_matches_sequential(pp4_mesh, rng, n_micro):
+    stacked = _make_stage_params(rng, 4)
+    batches = {
+        "x": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+        "y": jnp.asarray(rng.standard_normal((n_micro, 2, HID)), jnp.float32),
+    }
+    ref_loss, ref_grads = _sequential_reference(stacked, batches)
+
+    def run(stage_params, batches):
+        p = jax.tree.map(lambda l: l[0], stage_params)
+        loss, grads = forward_backward_pipelining_1f1b(SPEC, p, batches)
+        return loss, jax.tree.map(lambda l: l[None], grads)
+
+    loss, grads = jax.jit(shard_map(
+        run, mesh=pp4_mesh,
+        in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+        out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+        check_vma=False,
+    ))(stacked, batches)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads["b"]), np.asarray(ref_grads["b"]),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_1f1b_memory_flat_in_num_microbatches(pp4_mesh, rng):
+    """The 1F1B memory contract: compiled temp memory must stay flat as
+    num_microbatches grows (the two-sweep autodiff schedule grows O(n))."""
+
+    def temp_bytes(schedule, n_micro):
+        batches = {
+            "x": jnp.zeros((n_micro, 2, HID), jnp.float32),
+            "y": jnp.zeros((n_micro, 2, HID), jnp.float32),
+        }
+        stacked = _make_stage_params(rng, 4)
+
+        def run(stage_params, batches):
+            p = jax.tree.map(lambda l: l[0], stage_params)
+            loss, grads = schedule(SPEC, p, batches)
+            return loss, jax.tree.map(lambda l: l[None], grads)
+
+        fn = jax.jit(shard_map(
+            run, mesh=pp4_mesh,
+            in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+            out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
+            check_vma=False))
+        mem = fn.lower(stacked, batches).compile().memory_analysis()
+        assert mem is not None, "memory analysis unavailable on this backend"
+        return mem.temp_size_in_bytes
+
+    small = temp_bytes(forward_backward_pipelining_1f1b, 4)
+    large = temp_bytes(forward_backward_pipelining_1f1b, 32)
+    # 8x the microbatches must not cost anywhere near 8x the temps; allow
+    # slack for XLA bookkeeping noise
+    assert large <= small * 1.5 + 4096, (small, large)
+
+    # and the bound is REAL: the autodiff two-sweep schedule's temps do
+    # grow with n (this is the gap 1F1B exists to close)
+    sweep_small = temp_bytes(
+        forward_backward_pipelining_without_interleaving, 4)
+    sweep_large = temp_bytes(
+        forward_backward_pipelining_without_interleaving, 32)
+    assert sweep_large > sweep_small * 2, (sweep_small, sweep_large)
+
+
 def test_no_pipelining_matches_fullbatch(rng):
     params = {"w": jnp.asarray(rng.standard_normal((HID, HID)) * 0.3, jnp.float32)}
     batches = {
@@ -130,8 +198,9 @@ def test_no_pipelining_matches_fullbatch(rng):
 
 def test_get_forward_backward_func():
     assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+    # non-interleaved pp dispatches to the memory-bounded 1F1B schedule
     assert (get_forward_backward_func(None, 4)
-            is forward_backward_pipelining_without_interleaving)
+            is forward_backward_pipelining_1f1b)
     assert (get_forward_backward_func(2, 4)
             is forward_backward_pipelining_with_interleaving)
 

@@ -1,0 +1,11 @@
+"""apex_tpu.RNN — scan-based recurrent cells (apex.RNN parity).
+
+Parity target: ``apex.RNN`` (RNNBackend.py:25-380, cells.py, models.py):
+``LSTM/GRU/ReLU/Tanh/mLSTM`` factories over stacked / bidirectional
+fused-cell RNNs.  Deprecated upstream but part of the surface.
+"""
+
+from apex_tpu.RNN.models import GRU, LSTM, ReLU, Tanh, mLSTM
+from apex_tpu.RNN.rnn import RNNBackend
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM", "RNNBackend", "models"]

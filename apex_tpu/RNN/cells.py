@@ -1,0 +1,58 @@
+"""Recurrent cell math (apex/RNN/cells.py + torch backend cell parity).
+
+Each cell is a pure function ``cell(x_gates, h_gates, hidden) -> hidden'``
+over pre-computed gate projections — the layout that lets the sequence
+loop hoist the input projection out of the scan (one big [T*B, gate] MXU
+matmul instead of T small ones), which is the TPU analog of the
+reference's fused LSTM kernel (RNNBackend fusedBackend.LSTMFused).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["lstm_cell", "gru_cell", "relu_cell", "tanh_cell",
+           "CELL_SPECS"]
+
+
+def lstm_cell(igates, hgates, hidden):
+    """4-gate LSTM (torch.nn.LSTMCell math): hidden = (h, c)."""
+    _, cx = hidden
+    i, f, g, o = jnp.split(igates + hgates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * cx + i * g
+    h = o * jnp.tanh(c)
+    return (h, c)
+
+
+def gru_cell(igates, hgates, hidden):
+    """3-gate GRU (torch.nn.GRUCell math): hidden = (h,)."""
+    (hx,) = hidden
+    ir, iz, in_ = jnp.split(igates, 3, axis=-1)
+    hr, hz, hn = jnp.split(hgates, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(in_ + r * hn)
+    return ((1 - z) * n + z * hx,)
+
+
+def relu_cell(igates, hgates, hidden):
+    del hidden
+    return (jax.nn.relu(igates + hgates),)
+
+
+def tanh_cell(igates, hgates, hidden):
+    del hidden
+    return (jnp.tanh(igates + hgates),)
+
+
+# name -> (gate_multiplier, n_hidden_states, cell_fn) — the RNNCell
+# constructor triple (RNNBackend.py:242)
+CELL_SPECS = {
+    "lstm": (4, 2, lstm_cell),
+    "gru": (3, 1, gru_cell),
+    "relu": (1, 1, relu_cell),
+    "tanh": (1, 1, tanh_cell),
+}

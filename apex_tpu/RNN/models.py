@@ -1,0 +1,55 @@
+"""Factory functions (apex/RNN/models.py:9-56 parity)."""
+
+from __future__ import annotations
+
+from apex_tpu.RNN.rnn import RNNBackend
+
+__all__ = ["LSTM", "GRU", "ReLU", "Tanh", "mLSTM"]
+
+
+def _make(cell_type, input_size, hidden_size, num_layers, bias, batch_first,
+          dropout, bidirectional, mlstm=False):
+    return RNNBackend(cell_type=cell_type, input_size=input_size,
+                      hidden_size=hidden_size, num_layers=num_layers,
+                      bias=bias, batch_first=batch_first, dropout=dropout,
+                      bidirectional=bidirectional, mlstm=mlstm)
+
+
+def LSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    """models.py:21 — stacked LSTM."""
+    del output_size  # recurrent projection: not carried over (deprecated)
+    return _make("lstm", input_size, hidden_size, num_layers, bias,
+                 batch_first, dropout, bidirectional)
+
+
+def GRU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+        dropout=0, bidirectional=False, output_size=None):
+    """models.py:28 — stacked GRU."""
+    del output_size
+    return _make("gru", input_size, hidden_size, num_layers, bias,
+                 batch_first, dropout, bidirectional)
+
+
+def ReLU(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    """models.py:35 — Elman RNN with ReLU nonlinearity."""
+    del output_size
+    return _make("relu", input_size, hidden_size, num_layers, bias,
+                 batch_first, dropout, bidirectional)
+
+
+def Tanh(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+         dropout=0, bidirectional=False, output_size=None):
+    """models.py:42 — Elman RNN with tanh nonlinearity."""
+    del output_size
+    return _make("tanh", input_size, hidden_size, num_layers, bias,
+                 batch_first, dropout, bidirectional)
+
+
+def mLSTM(input_size, hidden_size, num_layers, bias=True, batch_first=False,
+          dropout=0, bidirectional=False, output_size=None):
+    """models.py:49 — multiplicative LSTM (cells.py mLSTMCell)."""
+    del output_size
+    return _make("lstm", input_size, hidden_size, num_layers, bias,
+                 batch_first, dropout, bidirectional, mlstm=True)

@@ -1,0 +1,143 @@
+"""Stacked / bidirectional scan RNN (apex/RNN/RNNBackend.py parity).
+
+TPU design: the reference steps python-loop-per-timestep over cell modules
+(stackedRNN.forward, RNNBackend.py:122-196).  Here each layer is ONE
+``lax.scan`` over time with the input-side gate projection hoisted out of
+the scan — the whole sequence's input gates are a single [T*B, gates]
+matmul on the MXU — and only the [B, gates] recurrent matmul runs per
+step.  Bidirectional runs a reversed scan and concatenates features
+(bidirectionalRNN, RNNBackend.py:25-88).  mLSTM (cells.py:12-90) applies
+the multiplicative projection before the gate matmuls.
+
+Hidden state is explicit (JAX has no module state): ``__call__`` takes and
+returns it, ``init_hidden`` builds zeros — the functional forms of the
+reference's ``init_hidden``/``reset_hidden``/``detach_hidden``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+from apex_tpu.RNN.cells import CELL_SPECS
+
+__all__ = ["RNNBackend"]
+
+
+class _Layer(nn.Module):
+    cell_type: str
+    input_size: int
+    hidden_size: int
+    bias: bool
+    mlstm: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, xs, hidden, reverse: bool = False):
+        """xs [T, B, input_size]; hidden tuple of [B, hidden_size]."""
+        mult, n_states, cell = CELL_SPECS[self.cell_type]
+        gate = mult * self.hidden_size
+        k = nn.initializers.lecun_normal()
+        w_ih = self.param("w_ih", k, (self.input_size, gate),
+                          self.param_dtype)
+        w_hh = self.param("w_hh", k, (self.hidden_size, gate),
+                          self.param_dtype)
+        b_ih = b_hh = 0.0
+        if self.bias:
+            b_ih = self.param("b_ih", nn.initializers.zeros, (gate,),
+                              self.param_dtype)
+            b_hh = self.param("b_hh", nn.initializers.zeros, (gate,),
+                              self.param_dtype)
+        if self.mlstm:
+            w_mih = self.param("w_mih", k,
+                               (self.input_size, self.hidden_size),
+                               self.param_dtype)
+            w_mhh = self.param("w_mhh", k,
+                               (self.hidden_size, self.hidden_size),
+                               self.param_dtype)
+
+        if not self.mlstm:
+            # hoist the input projection: one [T*B, gate] MXU matmul
+            igates_seq = xs @ w_ih + b_ih
+
+            def step(h, ig):
+                new = cell(ig, h[0] @ w_hh + b_hh, h)
+                return new, new[0]
+        else:
+            igates_seq = xs  # m depends on h, so project inside the scan
+
+            def step(h, x_t):
+                m = (x_t @ w_mih) * (h[0] @ w_mhh)
+                new = cell(x_t @ w_ih + b_ih, m @ w_hh + b_hh, h)
+                return new, new[0]
+
+        final, ys = jax.lax.scan(step, hidden, igates_seq, reverse=reverse)
+        return ys, final
+
+
+class RNNBackend(nn.Module):
+    """Stacked (optionally bidirectional) RNN.
+
+    ``__call__(x, hidden=None)`` with x [T, B, F] (or [B, T, F] with
+    ``batch_first``) returns ``(output, final_hidden)`` where output is
+    [T, B, H * (2 if bidirectional else 1)] and final_hidden is a list of
+    per-layer (per-direction) hidden tuples.
+    """
+
+    cell_type: str            # 'lstm' | 'gru' | 'relu' | 'tanh'
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    batch_first: bool = False
+    dropout: float = 0.0
+    bidirectional: bool = False
+    mlstm: bool = False
+    param_dtype: Any = jnp.float32
+
+    def init_hidden(self, bsz: int):
+        """Zero hidden states for every layer/direction
+        (RNNBackend.init_hidden:59-65)."""
+        _, n_states, _ = CELL_SPECS[self.cell_type]
+        dirs = 2 if self.bidirectional else 1
+        zeros = lambda: tuple(
+            jnp.zeros((bsz, self.hidden_size), self.param_dtype)
+            for _ in range(n_states))
+        return [zeros() for _ in range(self.num_layers * dirs)]
+
+    @nn.compact
+    def __call__(self, x, hidden=None, *, deterministic: bool = True):
+        if self.batch_first:
+            x = jnp.swapaxes(x, 0, 1)
+        T, B = x.shape[0], x.shape[1]
+        if hidden is None:
+            hidden = self.init_hidden(B)
+
+        dirs = 2 if self.bidirectional else 1
+        finals = []
+        feat = x
+        for layer in range(self.num_layers):
+            in_size = self.input_size if layer == 0 else self.hidden_size * dirs
+            outs = []
+            for d in range(dirs):
+                cell_layer = _Layer(
+                    cell_type=self.cell_type, input_size=in_size,
+                    hidden_size=self.hidden_size, bias=self.bias,
+                    mlstm=self.mlstm, param_dtype=self.param_dtype,
+                    name=f"layer{layer}_dir{d}")
+                ys, fin = cell_layer(feat, hidden[layer * dirs + d],
+                                     reverse=(d == 1))
+                outs.append(ys)
+                finals.append(fin)
+            feat = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1 \
+                    and not deterministic:
+                feat = nn.Dropout(self.dropout, deterministic=False)(feat)
+
+        if self.batch_first:
+            feat = jnp.swapaxes(feat, 0, 1)
+        return feat, finals

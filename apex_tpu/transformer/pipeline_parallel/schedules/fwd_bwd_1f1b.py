@@ -60,8 +60,13 @@ def forward_backward_pipelining_1f1b(
     axis_name: str = PIPELINE_PARALLEL_AXIS,
     grad_scaler=None,
     scaler_state=None,
-    # reference-API compat; static shapes make these meaningless here
+    # stage recompute is ALWAYS on here — the O(pp) memory bound depends on
+    # it (backwards recompute from banked inputs); checkpoint_stages=False
+    # is accepted for two-sweep API compat but cannot disable it.  Use the
+    # two-sweep schedule for no-recompute, or jax.checkpoint policies
+    # inside stage_fn for selective remat.
     checkpoint_stages: bool = True,
+    # shape negotiation is meaningless under jit (static shapes):
     tensor_shape=None,
     dtype=None,
     disable_autocast: bool = False,

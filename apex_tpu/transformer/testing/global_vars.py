@@ -1,0 +1,115 @@
+"""Global singletons for Megatron-style training scripts.
+
+Parity target: ``apex.transformer.testing.global_vars`` (global_vars.py:26-
+190): ``get_args`` / ``get_num_microbatches`` /
+``get_current_global_batch_size`` / ``update_num_microbatches`` /
+``get_tensorboard_writer`` / ``get_timers`` behind ``set_global_variables``.
+
+The autoresume hook (ADLR cluster infra) has no TPU analog and is omitted;
+everything else is shared machinery: the microbatch calculator is
+:mod:`apex_tpu.transformer.microbatches`, timers are the pipeline
+``Timers``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
+from apex_tpu.transformer.pipeline_parallel._timers import Timers
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_TIMERS = None
+
+__all__ = [
+    "get_args", "get_num_microbatches", "get_current_global_batch_size",
+    "update_num_microbatches", "get_tensorboard_writer", "get_timers",
+    "set_global_variables", "destroy_global_vars",
+]
+
+
+def _ensure_initialized(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized "
+                           "(call set_global_variables first)")
+    return var
+
+
+def _ensure_not_initialized(var, name):
+    if var is not None:
+        raise RuntimeError(f"{name} is already initialized")
+
+
+def get_args():
+    return _ensure_initialized(_GLOBAL_ARGS, "args")
+
+
+def get_num_microbatches() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).get_current_global_batch_size()
+
+
+def update_num_microbatches(consumed_samples: int, *,
+                            consistency_check: bool = True) -> None:
+    _ensure_initialized(
+        _GLOBAL_NUM_MICROBATCHES_CALCULATOR, "num microbatches calculator"
+    ).update(consumed_samples, consistency_check)
+
+
+def get_tensorboard_writer():
+    return _GLOBAL_TENSORBOARD_WRITER  # optional: None when not configured
+
+
+def get_timers() -> Timers:
+    return _ensure_initialized(_GLOBAL_TIMERS, "timers")
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         override_args=None, ignore_unknown_args=False,
+                         args_list=None):
+    """Parse args and build every singleton (global_vars.py:87-101)."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    global _GLOBAL_TENSORBOARD_WRITER
+    _ensure_not_initialized(_GLOBAL_ARGS, "args")
+    # build every component BEFORE assigning any global: a failure partway
+    # must leave the singleton clean, not half-initialized
+    args = parse_args(extra_args_provider=extra_args_provider,
+                      defaults=args_defaults, override_args=override_args,
+                      ignore_unknown_args=ignore_unknown_args,
+                      args_list=args_list)
+    calculator = build_num_microbatches_calculator(
+        args.rank, args.rampup_batch_size, args.global_batch_size,
+        args.micro_batch_size, args.data_parallel_size)
+    writer = None
+    if args.tensorboard_dir is not None:
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            writer = SummaryWriter(log_dir=args.tensorboard_dir)
+        except ImportError:
+            writer = None
+    _GLOBAL_ARGS = args
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = calculator
+    _GLOBAL_TENSORBOARD_WRITER = writer
+    _GLOBAL_TIMERS = Timers()
+    return args
+
+
+def destroy_global_vars():
+    """Testing hook mirroring parallel_state.destroy_model_parallel."""
+    global _GLOBAL_ARGS, _GLOBAL_NUM_MICROBATCHES_CALCULATOR, _GLOBAL_TIMERS
+    global _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_ARGS = None
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+    _GLOBAL_TIMERS = None

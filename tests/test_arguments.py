@@ -1,0 +1,115 @@
+"""Megatron-style argument parsing + global singletons."""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu.transformer.testing import (
+    core_transformer_config_from_args,
+    destroy_global_vars,
+    get_args,
+    get_current_global_batch_size,
+    get_num_microbatches,
+    get_timers,
+    parse_args,
+    set_global_variables,
+    update_num_microbatches,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    destroy_global_vars()
+    yield
+    destroy_global_vars()
+
+
+GPT_ARGS = [
+    "--num-layers", "4", "--hidden-size", "64", "--num-attention-heads", "4",
+    "--seq-length", "128", "--max-position-embeddings", "128",
+    "--micro-batch-size", "2", "--global-batch-size", "16",
+    "--vocab-size", "1024", "--lr", "1e-4", "--bf16",
+]
+
+
+def test_parse_args_derivations(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    args = parse_args(args_list=GPT_ARGS + [
+        "--tensor-model-parallel-size", "2",
+        "--pipeline-model-parallel-size", "2"])
+    assert args.data_parallel_size == 2
+    assert args.ffn_hidden_size == 4 * 64
+    assert args.kv_channels == 16
+    assert args.params_dtype == jnp.bfloat16
+    cfg = core_transformer_config_from_args(args)
+    assert cfg["vocab_size"] == 1024 and cfg["max_sequence_length"] == 128
+
+
+def test_parse_args_validation(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "4")
+    with pytest.raises(ValueError):
+        parse_args(args_list=GPT_ARGS + [
+            "--tensor-model-parallel-size", "3"])
+    with pytest.raises(ValueError):
+        parse_args(args_list=GPT_ARGS + ["--fp16"])  # fp16+bf16
+
+
+def test_virtual_pipeline_derivation(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    args = parse_args(args_list=GPT_ARGS + [
+        "--pipeline-model-parallel-size", "2",
+        "--num-layers-per-virtual-pipeline-stage", "1"])
+    assert args.virtual_pipeline_model_parallel_size == 2  # 4 layers/2pp/1
+    with pytest.raises(ValueError):
+        parse_args(args_list=GPT_ARGS + [
+            "--pipeline-model-parallel-size", "2",
+            "--num-layers-per-virtual-pipeline-stage", "3"])
+
+
+def test_missing_required_args_clear_error(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    with pytest.raises(ValueError, match="--num-layers is required"):
+        parse_args(args_list=["--micro-batch-size", "2"])
+
+
+def test_failed_init_leaves_globals_clean(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    with pytest.raises(ValueError):
+        set_global_variables(args_list=GPT_ARGS + [
+            "--rampup-batch-size", "4", "2"])  # needs 3 values
+    # retry after fixing succeeds — no poisoned half-initialized singleton
+    set_global_variables(args_list=GPT_ARGS)
+    assert get_args().hidden_size == 64
+
+
+def test_fp16_defaults_dynamic_scale(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    args = parse_args(args_list=[a for a in GPT_ARGS if a != "--bf16"]
+                      + ["--fp16"])
+    assert args.params_dtype == jnp.float16
+    assert args.loss_scale == "dynamic"
+
+
+def test_global_vars_lifecycle(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    with pytest.raises(RuntimeError):
+        get_args()
+    set_global_variables(args_list=GPT_ARGS)
+    assert get_args().hidden_size == 64
+    assert get_num_microbatches() == 8  # 16 / (2 * dp=1)
+    assert get_current_global_batch_size() == 16
+    update_num_microbatches(100)
+    t = get_timers()
+    with t("demo").timing():
+        pass
+    assert t("demo").elapsed() >= 0
+    with pytest.raises(RuntimeError):
+        set_global_variables(args_list=GPT_ARGS)  # double init
+
+
+def test_rampup_flows_through_globals(monkeypatch):
+    monkeypatch.setenv("WORLD_SIZE", "1")
+    set_global_variables(args_list=GPT_ARGS + [
+        "--rampup-batch-size", "4", "2", "100"])
+    assert get_current_global_batch_size() == 4
+    update_num_microbatches(200, consistency_check=True)
+    assert get_current_global_batch_size() == 16

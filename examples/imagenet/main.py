@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import functools
+import os
 import time
 
 import jax
@@ -93,43 +94,84 @@ def cross_entropy(logits, labels):
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
-    ap.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
-    ap.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
-    ap.add_argument("--batch-size", type=int, default=64, help="global batch")
-    ap.add_argument("--image-size", type=int, default=224)
-    ap.add_argument("--num-classes", type=int, default=1000)
-    ap.add_argument("--steps", type=int, default=20)
-    ap.add_argument("--lr", type=float, default=0.1)
-    # This example trains on synthetic data only (the reference's main_amp.py
-    # folder-loading belongs to a data-pipeline library, out of scope here).
-    ap.add_argument("--prof", action="store_true",
-                    help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
-    args = ap.parse_args()
+def save_checkpoint(path, step, params, batch_stats, opt_state, scaler_state):
+    """End-to-end checkpointing (main_amp.py:177-193 + 'Checkpointing' in
+    the apex README): every piece of training state round-trips."""
+    import orbax.checkpoint as ocp
 
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.join(path, f"step_{step}"), {
+            "step": step,
+            "params": params,
+            "batch_stats": batch_stats,
+            "opt_state": opt_state,
+            "scaler_state": scaler_state,
+        }, force=True)
+    return path
+
+
+def load_checkpoint(path, template):
+    """Restore the latest step under ``path`` against a state template."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    # only fully-numeric suffixes: interrupted saves leave orbax tmp dirs
+    # like step_4.orbax-checkpoint-tmp-1234 that must not break resume
+    steps = sorted(int(d[len("step_"):]) for d in os.listdir(path)
+                   if d.startswith("step_") and d[len("step_"):].isdigit())
+    if not steps:
+        raise FileNotFoundError(f"no checkpoints under {path}")
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(os.path.join(path, f"step_{steps[-1]}"),
+                             template)
+
+
+def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
+                 image_size=224, num_classes=1000, steps=20, lr=0.1,
+                 loss_scale=None, save=None, save_interval=None, resume=None,
+                 prof=False, seed=0, verbose=True):
+    """Train on synthetic data; returns the per-step loss trace + throughput.
+
+    Programmatic form of the reference CLI so the L1 convergence harness
+    (tests/L1/common/run_test.sh:19-40) can sweep opt_level × loss_scale
+    and diff the traces.
+    """
     devices = jax.devices()
     mesh = Mesh(np.array(devices), ("dp",))
-    print(f"devices: {len(devices)} × {devices[0].platform}")
+    if verbose:
+        print(f"devices: {len(devices)} × {devices[0].platform}")
 
-    half = jnp.bfloat16 if args.half == "bf16" else jnp.float16
-    policy = get_policy(args.opt_level, half_dtype=half)
-    model = (resnet50 if args.arch == "resnet50" else resnet18_ish)(
-        args.num_classes, axis_name=None)  # pjit-style: stats are global already
+    half_dtype = jnp.bfloat16 if half == "bf16" else jnp.float16
+    overrides = {} if loss_scale is None else {"loss_scale": loss_scale}
+    policy = get_policy(opt_level, half_dtype=half_dtype, **overrides)
+    model = (resnet50 if arch == "resnet50" else resnet18_ish)(
+        num_classes, axis_name=None)  # pjit-style: stats are global already
     ddp = DistributedDataParallel(axis_name="dp", mesh=mesh)
 
-    rng = jax.random.PRNGKey(0)
-    x0 = jnp.zeros((2, args.image_size, args.image_size, 3), jnp.float32)
+    rng = jax.random.PRNGKey(seed)
+    x0 = jnp.zeros((2, image_size, image_size, 3), jnp.float32)
     variables = model.init(rng, x0, train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
     params = policy.cast_params(params)
 
-    opt = FusedSGD(lr=args.lr, momentum=0.9, weight_decay=1e-4,
+    opt = FusedSGD(lr=lr, momentum=0.9, weight_decay=1e-4,
                    master_weights=policy.master_weights)
     opt_state = opt.init(params)
     scaler = policy.make_scaler()
     scaler_state = scaler.init()
+
+    start_step = 0
+    if resume is not None:
+        template = {"step": 0, "params": params, "batch_stats": batch_stats,
+                    "opt_state": opt_state, "scaler_state": scaler_state}
+        restored = load_checkpoint(resume, template)
+        start_step = int(restored["step"])
+        params, batch_stats = restored["params"], restored["batch_stats"]
+        opt_state = restored["opt_state"]
+        scaler_state = restored["scaler_state"]
+        if verbose:
+            print(f"=> resumed from {resume} at step {start_step}")
 
     # replicate model state, shard batch over dp
     params, opt_state, batch_stats = ddp.replicate((params, opt_state, batch_stats))
@@ -141,39 +183,94 @@ def main():
             logits, upd = model.apply(
                 {"params": p, "batch_stats": batch_stats},
                 policy.cast_inputs(images), train=True, mutable=["batch_stats"])
-            return scaler.scale_loss(cross_entropy(logits, labels), scaler_state), upd
+            loss = cross_entropy(logits, labels)
+            return scaler.scale_loss(loss, scaler_state), (upd, loss)
 
-        grads, upd = jax.grad(loss_fn, has_aux=True)(params)
+        grads, (upd, loss) = jax.grad(loss_fn, has_aux=True)(params)
         grads, found_inf = scaler.unscale(grads, scaler_state)
         new_params, new_opt = opt.step(grads, params, opt_state, found_inf=found_inf)
         new_scaler = scaler.update(scaler_state, found_inf)
-        return new_params, upd["batch_stats"], new_opt, new_scaler, found_inf
+        return (new_params, upd["batch_stats"], new_opt, new_scaler, loss,
+                found_inf)
 
-    per_host = args.batch_size
-    key = np.random.default_rng(0)
+    key = np.random.default_rng(seed)
     images = jnp.asarray(key.standard_normal(
-        (per_host, args.image_size, args.image_size, 3)), jnp.float32)
-    labels = jnp.asarray(key.integers(0, args.num_classes, per_host), jnp.int32)
+        (batch_size, image_size, image_size, 3)), jnp.float32)
+    labels = jnp.asarray(key.integers(0, num_classes, batch_size), jnp.int32)
     images, labels = ddp.shard_batch((images, labels))
 
+    losses = []
     with mesh:
         t0 = None
-        for step in range(args.steps):
-            if args.prof and step == 5:
+        found_inf = False
+        for step in range(start_step, steps):
+            if prof and step == 5:
                 jax.profiler.start_trace("/tmp/apex_tpu_trace")
-            params, batch_stats, opt_state, scaler_state, found_inf = train_step(
-                params, batch_stats, opt_state, scaler_state, images, labels)
-            if args.prof and step == 10:
+            params, batch_stats, opt_state, scaler_state, loss, found_inf = \
+                train_step(params, batch_stats, opt_state, scaler_state,
+                           images, labels)
+            losses.append(loss)  # device array: no per-step host sync
+            if prof and step == 10:
                 jax.profiler.stop_trace()
-            if step == 1:  # skip compile
+            if step == start_step + 1:  # skip compile
                 jax.block_until_ready(params)
                 t0 = time.perf_counter()
+            if save is not None and save_interval and \
+                    (step + 1) % save_interval == 0:
+                save_checkpoint(save, step + 1, params, batch_stats,
+                                opt_state, scaler_state)
         jax.block_until_ready(params)
-        dt = time.perf_counter() - t0
-        imgs_per_sec = args.batch_size * (args.steps - 2) / dt
-    print(f"throughput: {imgs_per_sec:.1f} imgs/sec "
-          f"({imgs_per_sec / len(devices):.1f}/chip), overflow={bool(found_inf)}")
-    print("OK")
+        ran = steps - start_step
+        if ran > 2 and t0 is not None:
+            dt = time.perf_counter() - t0
+            imgs_per_sec = batch_size * (ran - 2) / dt
+        else:
+            imgs_per_sec = float("nan")  # too few post-compile steps to time
+        losses = [float(l) for l in losses]
+    if save is not None:
+        save_checkpoint(save, steps, params, batch_stats, opt_state,
+                        scaler_state)
+    if verbose:
+        print(f"throughput: {imgs_per_sec:.1f} imgs/sec "
+              f"({imgs_per_sec / len(devices):.1f}/chip), "
+              f"overflow={bool(found_inf)}")
+        print("OK")
+    return {"losses": losses, "imgs_per_sec": imgs_per_sec,
+            "final_scale": float(jax.tree.leaves(scaler_state)[0])
+            if jax.tree.leaves(scaler_state) else 1.0}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    ap.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
+    ap.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
+    ap.add_argument("--batch-size", type=int, default=64, help="global batch")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--num-classes", type=int, default=1000)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--loss-scale", default=None,
+                    help='None | float | "dynamic" (main_amp.py --loss-scale)')
+    ap.add_argument("--save", default=None, help="checkpoint directory")
+    ap.add_argument("--save-interval", type=int, default=None)
+    ap.add_argument("--resume", default=None,
+                    help="checkpoint directory to resume from "
+                         "(main_amp.py:177-193)")
+    # This example trains on synthetic data only (the reference's main_amp.py
+    # folder-loading belongs to a data-pipeline library, out of scope here).
+    ap.add_argument("--prof", action="store_true",
+                    help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
+    args = ap.parse_args()
+    loss_scale = args.loss_scale
+    if loss_scale is not None and loss_scale != "dynamic":
+        loss_scale = float(loss_scale)
+    run_training(arch=args.arch, opt_level=args.opt_level, half=args.half,
+                 batch_size=args.batch_size, image_size=args.image_size,
+                 num_classes=args.num_classes, steps=args.steps, lr=args.lr,
+                 loss_scale=loss_scale, save=args.save,
+                 save_interval=args.save_interval, resume=args.resume,
+                 prof=args.prof)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,83 @@
+"""L1 convergence cross-product harness.
+
+Parity target: ``tests/L1/common/run_test.sh:19-40`` +
+``compare.py``: train the ImageNet example under every
+(opt_level × loss_scale) combination, diff each loss trace against the O0
+fp32 baseline, and fail on divergence.
+
+Usage: python run_convergence.py [--steps 12] [--image-size 64] ...
+Prints one row per combo and exits nonzero if any combo diverges.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from main import run_training
+
+OPT_LEVELS = ["O0", "O1", "O2", "O3"]
+LOSS_SCALES = [None, 1.0, 128.0, "dynamic"]
+
+
+def run_cross_product(steps=12, image_size=64, batch_size=16, num_classes=100,
+                      arch="resnet18", half="bf16", lr=0.05, rtol=0.15,
+                      atol=0.25, verbose=True):
+    """Returns (results dict, list of failing combo names)."""
+    baseline = run_training(arch=arch, opt_level="O0", steps=steps,
+                            image_size=image_size, batch_size=batch_size,
+                            num_classes=num_classes, lr=lr,
+                            verbose=False)["losses"]
+    results, failures = {"O0/none": baseline}, []
+    for level in OPT_LEVELS[1:]:  # O0 is the baseline; scaling is moot there
+        for scale in LOSS_SCALES:
+            name = f"{level}/{scale if scale is not None else 'none'}"
+            trace = run_training(arch=arch, opt_level=level, half=half,
+                                 steps=steps, image_size=image_size,
+                                 batch_size=batch_size,
+                                 num_classes=num_classes, loss_scale=scale,
+                                 lr=lr, verbose=False)["losses"]
+            results[name] = trace
+            # a dynamic scaler backs off from 65536 by skipping early
+            # steps: the converging trace is O0's, delayed by the skips
+            skips = 0
+            while skips < 3 and np.isclose(trace[skips + 1], trace[0],
+                                           rtol=1e-5):
+                skips += 1
+            close = np.allclose(trace[skips:],
+                                baseline[:len(baseline) - skips],
+                                rtol=rtol, atol=atol)
+            decreasing = trace[-1] < trace[0]
+            status = "OK" if (close and decreasing) else "DIVERGED"
+            if status != "OK":
+                failures.append(name)
+            if verbose:
+                print(f"{name:>14}: first={trace[0]:.4f} last={trace[-1]:.4f} "
+                      f"max|Δ|={np.abs(np.array(trace) - baseline).max():.4f} "
+                      f"{status}")
+    return results, failures
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--num-classes", type=int, default=100)
+    ap.add_argument("--arch", default="resnet18")
+    ap.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--rtol", type=float, default=0.15)
+    ap.add_argument("--atol", type=float, default=0.25)
+    args = ap.parse_args()
+    _, failures = run_cross_product(**vars(args))
+    if failures:
+        print(f"FAILED combos: {failures}")
+        sys.exit(1)
+    print("all combos converged within tolerance of O0")
+
+
+if __name__ == "__main__":
+    main()

@@ -140,3 +140,219 @@ def _jnp_adam(g, p, m, v, scalars, adam_w_mode):
     return (jnp.where(keep, p_new, p32).astype(p.dtype),
             jnp.where(keep, m_new, m),
             jnp.where(keep, v_new, v))
+
+
+# ---------------------------------------------------------------------------
+# packed SGD lives in this module too (kernel above); the remaining fused
+# optimizers' packed paths follow.  LAMB/NovoGrad need *per-tensor* segment
+# reductions over the flat buffer (trust ratios / per-tensor second moments)
+# — those reductions run as XLA segment_sums (which lower to one fused
+# scatter-add sweep) sandwiching the Pallas elementwise phases.
+# ---------------------------------------------------------------------------
+
+import numpy as np
+
+from apex_tpu.utils.packing import PackedSpec
+
+
+def segment_ids_for_spec(spec: PackedSpec) -> jnp.ndarray:
+    """Leaf index per flat element; padding gets the dead segment
+    ``spec.num_leaves`` (dropped by ``num_segments``-bounded reductions)."""
+    ids = np.full((spec.padded_total,), spec.num_leaves, np.int32)
+    for i, (shape, offset) in enumerate(zip(spec.shapes, spec.offsets)):
+        size = int(np.prod(shape)) if len(shape) else 1
+        ids[offset:offset + size] = i
+    return jnp.asarray(ids)
+
+
+def _segment_sqnorm(x32, seg_ids, num_segments):
+    return jax.ops.segment_sum(x32 * x32, seg_ids,
+                               num_segments=num_segments)
+
+
+def _lamb_phase1_kernel(g_ref, p_ref, m_ref, v_ref, scalars_ref,
+                        m_out, v_out, u_out, *, adam_w_mode):
+    """Elementwise LAMB moments + raw update (multi_tensor_lamb.cu stage 1).
+
+    scalars = [beta1, beta3, beta2, eps, wd, bc1, bc2, clip].
+    """
+    beta1 = scalars_ref[0]
+    beta3 = scalars_ref[1]
+    beta2 = scalars_ref[2]
+    eps = scalars_ref[3]
+    wd = scalars_ref[4]
+    bc1 = scalars_ref[5]
+    bc2 = scalars_ref[6]
+    clip = scalars_ref[7]
+
+    g = g_ref[:].astype(jnp.float32) / clip
+    p = p_ref[:].astype(jnp.float32)
+    if not adam_w_mode:
+        g = g + wd * p  # LAMB "MODE 0": L2 folded into the gradient
+    m_new = beta1 * m_ref[:] + beta3 * g
+    v_new = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+    if adam_w_mode:
+        update = update + wd * p
+    m_out[:] = m_new
+    v_out[:] = v_new
+    u_out[:] = update
+
+
+def packed_lamb_update(flat_grad, flat_param, flat_m, flat_v, seg_ids, *,
+                       num_leaves, lr, beta1, beta2, beta3, eps,
+                       weight_decay, bias_correction1, bias_correction2,
+                       global_clip, adam_w_mode: bool = True,
+                       use_nvlamb: bool = False):
+    """Packed FusedLAMB step over flat 1-D buffers.
+
+    Phase 1 (Pallas): moments + raw update, one sweep.  Phase 2 (XLA):
+    per-tensor ``||p||/||update||`` trust ratios via two segment reductions
+    and the final gathered-ratio apply — the fused equivalent of
+    multi_tensor_lamb.cu stage 2.  Returns (new_param, new_m, new_v).
+    """
+    n = flat_param.shape[0]
+    scalars = jnp.stack([
+        jnp.asarray(beta1, jnp.float32), jnp.asarray(beta3, jnp.float32),
+        jnp.asarray(beta2, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(bias_correction1, jnp.float32),
+        jnp.asarray(bias_correction2, jnp.float32),
+        jnp.asarray(global_clip, jnp.float32),
+    ])
+    p32 = flat_param.astype(jnp.float32)
+    if kernels_enabled() and n % 1024 == 0:
+        rows = n // 128
+        chunk_rows = min(_CHUNK // 128, rows)
+        while rows % chunk_rows:
+            chunk_rows //= 2
+        as2d = lambda a: a.reshape(rows, 128)
+        block = pl.BlockSpec((chunk_rows, 128), lambda i: (i, 0))
+        m_new, v_new, update = pl.pallas_call(
+            functools.partial(_lamb_phase1_kernel, adam_w_mode=adam_w_mode),
+            grid=(rows // chunk_rows,),
+            in_specs=[block, block, block, block,
+                      pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_specs=[block, block, block],
+            out_shape=[jax.ShapeDtypeStruct((rows, 128), jnp.float32)] * 3,
+            interpret=use_interpret(),
+        )(as2d(flat_grad), as2d(flat_param), as2d(flat_m), as2d(flat_v),
+          scalars)
+        m_new, v_new, update = (m_new.reshape(n), v_new.reshape(n),
+                                update.reshape(n))
+    else:
+        g = flat_grad.astype(jnp.float32) / scalars[7]
+        if not adam_w_mode:
+            g = g + scalars[4] * p32
+        m_new = scalars[0] * flat_m + scalars[1] * g
+        v_new = scalars[2] * flat_v + (1.0 - scalars[2]) * g * g
+        update = (m_new / scalars[5]) / (jnp.sqrt(v_new / scalars[6])
+                                         + scalars[3])
+        if adam_w_mode:
+            update = update + scalars[4] * p32
+
+    # phase 2: per-tensor trust ratios (dead padding segment dropped)
+    p_norms = jnp.sqrt(_segment_sqnorm(p32, seg_ids, num_leaves + 1))
+    u_norms = jnp.sqrt(_segment_sqnorm(update, seg_ids, num_leaves + 1))
+    ratios = jnp.where((p_norms > 0) & (u_norms > 0), p_norms / u_norms, 1.0)
+    if not (weight_decay or use_nvlamb):
+        ratios = jnp.ones_like(ratios)
+    p_new = p32 - jnp.asarray(lr, jnp.float32) * jnp.take(ratios, seg_ids) \
+        * update
+    return p_new.astype(flat_param.dtype), m_new, v_new
+
+
+def packed_novograd_update(flat_grad, flat_param, flat_m, seg_v, seg_ids, *,
+                           num_leaves, lr, beta1, beta2, beta3, eps,
+                           weight_decay, bias_correction1, bias_correction2,
+                           is_first_step, init_zero: bool = False,
+                           reg_inside_moment: bool = False):
+    """Packed FusedNovoGrad step; ``seg_v`` is the per-tensor second moment
+    of shape [num_leaves + 1] (NovoGrad's v is one scalar per tensor; the
+    final slot is the dead padding segment).  Entirely XLA: two segment ops bracket an elementwise
+    chain the compiler fuses into one sweep; a Pallas kernel would add
+    nothing (no reuse to capture, the chain is bandwidth-bound).
+    Returns (new_param, new_m, new_seg_v).
+    """
+    p32 = flat_param.astype(jnp.float32)
+    g = flat_grad.astype(jnp.float32)
+    g_sq = _segment_sqnorm(g, seg_ids, num_leaves + 1)
+    v_upd = beta2 * seg_v + (1.0 - beta2) * g_sq
+    v_init = jnp.zeros_like(g_sq) if init_zero else g_sq
+    v_new = jnp.where(is_first_step, v_init, v_upd)
+    denom = jnp.sqrt(v_new / bias_correction2) + eps
+    g_hat = g / jnp.take(denom, seg_ids)
+    if weight_decay and reg_inside_moment:
+        g_hat = g_hat + weight_decay * p32
+    m_new = beta1 * flat_m + beta3 * g_hat
+    update = m_new / bias_correction1
+    if weight_decay and not reg_inside_moment:
+        update = update + weight_decay * p32
+    p_new = p32 - jnp.asarray(lr, jnp.float32) * update
+    return p_new.astype(flat_param.dtype), m_new, v_new
+
+
+def _adagrad_kernel(g_ref, p_ref, h_ref, scalars_ref, p_out, h_out, *,
+                    adagrad_w_mode):
+    """scalars = [lr, eps, wd, noop]."""
+    lr = scalars_ref[0]
+    eps = scalars_ref[1]
+    wd = scalars_ref[2]
+    noop = scalars_ref[3]
+    g = g_ref[:].astype(jnp.float32)
+    p = p_ref[:].astype(jnp.float32)
+    if not adagrad_w_mode:
+        g = g + wd * p
+    h_new = h_ref[:] + g * g
+    update = g / (jnp.sqrt(h_new) + eps)
+    if adagrad_w_mode:
+        update = update + wd * p
+    p_new = p - lr * update
+    keep = noop == 0.0
+    p_out[:] = jnp.where(keep, p_new, p).astype(p_out.dtype)
+    h_out[:] = jnp.where(keep, h_new, h_ref[:])
+
+
+def packed_adagrad_update(flat_grad, flat_param, flat_h, *, lr, eps,
+                          weight_decay, adagrad_w_mode: bool = False,
+                          noop_flag=None):
+    """Packed FusedAdagrad step (csrc/multi_tensor_adagrad.cu math).
+    Returns (new_param, new_h)."""
+    n = flat_param.shape[0]
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32), jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        jnp.asarray(0.0 if noop_flag is None else noop_flag, jnp.float32),
+    ])
+    if not kernels_enabled() or n % 1024:
+        g = flat_grad.astype(jnp.float32)
+        p = flat_param.astype(jnp.float32)
+        if not adagrad_w_mode:
+            g = g + scalars[2] * p
+        h_new = flat_h + g * g
+        update = g / (jnp.sqrt(h_new) + scalars[1])
+        if adagrad_w_mode:
+            update = update + scalars[2] * p
+        p_new = p - scalars[0] * update
+        keep = scalars[3] == 0.0
+        return (jnp.where(keep, p_new, p).astype(flat_param.dtype),
+                jnp.where(keep, h_new, flat_h))
+    rows = n // 128
+    chunk_rows = min(_CHUNK // 128, rows)
+    while rows % chunk_rows:
+        chunk_rows //= 2
+    as2d = lambda a: a.reshape(rows, 128)
+    block = pl.BlockSpec((chunk_rows, 128), lambda i: (i, 0))
+    p_new, h_new = pl.pallas_call(
+        functools.partial(_adagrad_kernel, adagrad_w_mode=adagrad_w_mode),
+        grid=(rows // chunk_rows,),
+        in_specs=[block, block, block,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[block, block],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 128), flat_param.dtype),
+            jax.ShapeDtypeStruct((rows, 128), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(as2d(flat_grad), as2d(flat_param), as2d(flat_h), scalars)
+    return p_new.reshape(n), h_new.reshape(n)

@@ -42,10 +42,12 @@ class FusedLAMB(FusedOptimizer):
         max_grad_norm: float = 1.0,
         use_nvlamb: bool = False,
         master_weights: bool = False,
+        packed: bool = False,
     ):
         if amsgrad:
             raise RuntimeError("FusedLAMB does not support the AMSGrad variant.")
         super().__init__(master_weights=master_weights)
+        self.packed = packed
         self.lr = lr
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
@@ -57,10 +59,49 @@ class FusedLAMB(FusedOptimizer):
         self.use_nvlamb = use_nvlamb
 
     def _init(self, params: Any) -> LambState:
+        if self.packed:
+            # state lives flat: the multi-tensor layout (packed_update.py)
+            from apex_tpu.utils.packing import make_packed_spec
+
+            n = make_packed_spec(params).padded_total
+            z = jnp.zeros((n,), jnp.float32)
+            return LambState(jnp.int32(0), z, jnp.copy(z))
         z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         return LambState(jnp.int32(0), z, jax.tree.map(jnp.copy, z))
 
+    def _packed_update(self, grads: Any, params: Any, state: LambState):
+        """One packed multi-tensor sweep (ops/packed_update.py LAMB path)."""
+        from apex_tpu.ops.packed_update import (packed_lamb_update,
+                                                segment_ids_for_spec)
+        from apex_tpu.utils.packing import (make_packed_spec, pack_pytree,
+                                            unpack_pytree)
+
+        step = state.step + 1
+        spec = make_packed_spec(params)
+        flat_g = pack_pytree(grads, dtype=jnp.float32).flat
+        flat_p = pack_pytree(params).flat
+        seg_ids = segment_ids_for_spec(spec)
+
+        global_grad_norm = jnp.sqrt(jnp.sum(flat_g * flat_g))
+        clip = (jnp.maximum(global_grad_norm / self.max_grad_norm, 1.0)
+                if self.max_grad_norm else jnp.float32(1.0))
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        new_p, new_m, new_v = packed_lamb_update(
+            flat_g, flat_p, state.exp_avg, state.exp_avg_sq, seg_ids,
+            num_leaves=spec.num_leaves, lr=self.lr, beta1=self.beta1,
+            beta2=self.beta2,
+            beta3=(1.0 - self.beta1 if self.grad_averaging else 1.0),
+            eps=self.eps, weight_decay=self.weight_decay,
+            bias_correction1=bc1, bias_correction2=bc2, global_clip=clip,
+            adam_w_mode=self.adam_w_mode, use_nvlamb=self.use_nvlamb)
+        return unpack_pytree(new_p, spec), LambState(step, new_m, new_v)
+
     def _update(self, grads: Any, params: Any, state: LambState):
+        if self.packed:
+            return self._packed_update(grads, params, state)
         step = state.step + 1
         # Phase 1 (fused_lamb.py:138-162): global grad norm + clip coefficient.
         global_grad_norm = multi_tensor_l2norm(grads)

@@ -24,6 +24,12 @@ What does NOT carry over, by design (SURVEY.md §7 "wgrad accumulation"):
   scheduler overlaps the all-gather/reduce-scatter with the wgrad GEMMs; the
   ``no_async_tensor_model_parallel_allreduce`` knob is accepted and ignored.
 
+Neither claim is taken on faith: ``tests/test_hlo_comm_plan.py`` compiles
+this MLP fwd+bwd and asserts, on the optimized HLO, the exact Megatron
+collective plan (SP: 2 all-gather + 2 reduce-scatter, zero all-reduce;
+plain TP: 2 all-reduce) and that the wgrads survive as single dot
+contractions (bf16-operand on TPU).
+
 Weight shards are initialized with a rank-folded RNG so the full (gathered)
 weight matches a single full-size initialization draw pattern
 (_initialize_affine_weight_gpu's per-rank seed, random.py:124-235 semantics).

@@ -203,15 +203,18 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
     with mesh:
         t0 = None
         found_inf = False
+        tracing = False
         for step in range(start_step, steps):
             if prof and step == 5:
                 jax.profiler.start_trace("/tmp/apex_tpu_trace")
+                tracing = True
             params, batch_stats, opt_state, scaler_state, loss, found_inf = \
                 train_step(params, batch_stats, opt_state, scaler_state,
                            images, labels)
             losses.append(loss)  # device array: no per-step host sync
-            if prof and step == 10:
+            if tracing and step == 10:
                 jax.profiler.stop_trace()
+                tracing = False
             if step == start_step + 1:  # skip compile
                 jax.block_until_ready(params)
                 t0 = time.perf_counter()

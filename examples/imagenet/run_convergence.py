@@ -22,6 +22,16 @@ OPT_LEVELS = ["O0", "O1", "O2", "O3"]
 LOSS_SCALES = [None, 1.0, 128.0, "dynamic"]
 
 
+def count_scaler_skips(trace, max_skips=3):
+    """Leading steps skipped by dynamic-loss-scale backoff: the loss stays
+    at its initial value while the scaler halves down from 65536."""
+    skips = 0
+    while (skips < max_skips and skips + 1 < len(trace)
+           and np.isclose(trace[skips + 1], trace[0], rtol=1e-5)):
+        skips += 1
+    return skips
+
+
 def run_cross_product(steps=12, image_size=64, batch_size=16, num_classes=100,
                       arch="resnet18", half="bf16", lr=0.05, rtol=0.15,
                       atol=0.25, verbose=True):
@@ -42,10 +52,7 @@ def run_cross_product(steps=12, image_size=64, batch_size=16, num_classes=100,
             results[name] = trace
             # a dynamic scaler backs off from 65536 by skipping early
             # steps: the converging trace is O0's, delayed by the skips
-            skips = 0
-            while skips < 3 and np.isclose(trace[skips + 1], trace[0],
-                                           rtol=1e-5):
-                skips += 1
+            skips = count_scaler_skips(trace)
             close = np.allclose(trace[skips:],
                                 baseline[:len(baseline) - skips],
                                 rtol=rtol, atol=atol)

@@ -12,6 +12,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent
                        / "examples" / "imagenet"))
 
 from main import run_training  # noqa: E402
+from run_convergence import count_scaler_skips  # noqa: E402
 
 TINY = dict(arch="resnet18", steps=8, image_size=32, batch_size=8,
             num_classes=10, lr=0.05, verbose=False)
@@ -37,9 +38,7 @@ def test_policy_trace_matches_o0(o0_trace, opt_level, loss_scale, half):
     # dynamic scaling backs off from 65536 by skipping the first step(s);
     # the trajectory is the O0 one delayed by the skip count (the L0 amp
     # tests pin the same behavior for the reference's dynamic scaler)
-    skips = 0
-    while skips < 3 and np.isclose(trace[skips + 1], trace[0], rtol=1e-5):
-        skips += 1
+    skips = count_scaler_skips(trace)
     np.testing.assert_allclose(trace[skips:],
                                o0_trace[:len(o0_trace) - skips],
                                rtol=0.2, atol=0.35)

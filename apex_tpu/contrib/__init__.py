@@ -21,6 +21,7 @@ _SUBMODULES = (
     "groupbn",
     "index_mul_2d",
     "multihead_attn",
+    "openfold_triton",
     "optimizers",
     "sparsity",
     "transducer",

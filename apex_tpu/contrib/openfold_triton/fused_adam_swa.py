@@ -1,0 +1,135 @@
+"""FusedAdamSWA — Adam step + stochastic weight averaging in one sweep.
+
+Parity target: ``apex.contrib.openfold_triton.fused_adam_swa``
+(fused_adam_swa.py:54-470): a multi-tensor Triton kernel applying, per
+chunk, (1) optional grad-clip scaling, (2) one of three Adam math modes
+(ApexAdam / ApexAdamW / PyTorchAdam — fused_adam_swa.py:54-98), and
+(3) the SWA running average ``swa += (1 - decay) * (p - swa)`` with the
+``n_averaged == 0`` copy-through (fused_adam_swa.py:102-113), updating a
+separate compute-dtype parameter copy alongside the fp32 state params.
+
+TPU design: the whole step is one fused XLA sweep over the pytree; the
+SWA buffer and ``n_averaged`` live in the optimizer state.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.optimizers._common import (
+    apply_if_finite,
+    bias_corrections,
+    unscale_grads,
+)
+
+__all__ = ["AdamMathType", "FusedAdamSWA"]
+
+
+class AdamMathType(enum.Enum):
+    ApexAdam = 0
+    ApexAdamW = 1
+    PyTorchAdam = 2
+
+
+class AdamSWAState(NamedTuple):
+    step: jax.Array
+    n_averaged: jax.Array
+    exp_avg: Any
+    exp_avg_sq: Any
+    swa_params: Any    # fp32 running average
+    state_params: Any  # fp32 master copy (the reference's state params:
+    #                    updates accumulate here so sub-resolution steps on
+    #                    half-precision compute params are never lost)
+
+
+class FusedAdamSWA:
+    """Functional optimizer: ``step(grads, params, state)`` returns
+    ``(new_params, new_state)``; ``state.swa_params`` holds the average.
+    """
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 adam_math_mode: AdamMathType = AdamMathType.ApexAdam,
+                 bias_correction: bool = True,
+                 swa_decay_rate: float = 0.9,
+                 swa_start_step: int = 0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_math_mode = adam_math_mode
+        self.bias_correction = bias_correction
+        self.swa_decay_rate = swa_decay_rate
+        self.swa_start_step = swa_start_step
+
+    def init(self, params: Any) -> AdamSWAState:
+        z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        f32 = lambda: jax.tree.map(
+            lambda p: jnp.copy(p).astype(jnp.float32), params)
+        return AdamSWAState(jnp.int32(0), jnp.int32(0), z,
+                            jax.tree.map(jnp.copy, z), f32(), f32())
+
+    def step(self, grads: Any, params: Any, state: AdamSWAState, *,
+             grad_scale=None, found_inf=None
+             ) -> Tuple[Any, AdamSWAState]:
+        step = state.step + 1
+        g32 = unscale_grads(grads, grad_scale)
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        lr, wd, eps = self.lr, self.weight_decay, self.eps
+        b1, b2 = self.beta1, self.beta2
+        mode = self.adam_math_mode
+
+        def adam_leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            if mode in (AdamMathType.ApexAdam, AdamMathType.PyTorchAdam):
+                g = g + wd * p32
+            m_new = b1 * m + (1.0 - b1) * g
+            v_new = b2 * v + (1.0 - b2) * g * g
+            if mode is AdamMathType.PyTorchAdam:
+                denom = jnp.sqrt(v_new) / jnp.sqrt(bc2) + eps
+                p_new = p32 - (lr / bc1) * (m_new / denom)
+            else:
+                update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps)
+                if mode is AdamMathType.ApexAdamW:
+                    update = update + wd * p32
+                p_new = p32 - lr * update
+            return p_new, m_new, v_new
+
+        from apex_tpu.optimizers._common import tree_map_multi
+
+        # update the fp32 state params, not the (possibly half) compute
+        # params — fused_adam_swa.py's state/compute split
+        p_new, m_new, v_new = tree_map_multi(
+            adam_leaf, 3, state.state_params, g32, state.exp_avg,
+            state.exp_avg_sq)
+
+        # SWA (fused in the same sweep): first average copies through
+        do_swa = step > self.swa_start_step
+        n_avg = state.n_averaged + do_swa.astype(jnp.int32)
+        decay = jnp.float32(self.swa_decay_rate)
+
+        def swa_leaf(swa, p):
+            averaged = jnp.where(
+                state.n_averaged == 0, p,
+                swa + (1.0 - decay) * (p - swa))
+            return jnp.where(do_swa, averaged, swa)
+
+        swa_new = jax.tree.map(swa_leaf, state.swa_params, p_new)
+
+        new_state = AdamSWAState(step, n_avg, m_new, v_new, swa_new, p_new)
+        out_params = jax.tree.map(lambda n, p: n.astype(p.dtype), p_new,
+                                  params)
+        out_params = apply_if_finite(found_inf, out_params, params)
+        new_state = apply_if_finite(found_inf, new_state, state)
+        return out_params, new_state
+
+    def swa_state_dict(self, state: AdamSWAState):
+        """The averaged model (fused_adam_swa.py swa_param_views)."""
+        return state.swa_params

@@ -1,11 +1,15 @@
-"""apex_tpu.contrib.optimizers — ZeRO-2 distributed optimizers.
+"""apex_tpu.contrib.optimizers — ZeRO-2 distributed optimizers + legacy names.
 
 Parity: ``apex.contrib.optimizers`` (DistributedFusedAdam — ZeRO-2,
 distributed_fused_adam.py:273; DistributedFusedLAMB,
-distributed_fused_lamb.py:24).  The legacy contrib FP16_Optimizer and
-deprecated fused adam/lamb wrappers are subsumed by
-:mod:`apex_tpu.fp16_utils` and :mod:`apex_tpu.optimizers`.
+distributed_fused_lamb.py:24).  The deprecated contrib duplicates
+(fused_adam.py / fused_lamb.py / fused_sgd.py / fp16_optimizer.py — old
+copies of the apex.optimizers versions kept for script compatibility)
+resolve here to the maintained implementations with a DeprecationWarning,
+matching the reference's own guidance to migrate.
 """
+
+import warnings as _warnings
 
 from apex_tpu.contrib.optimizers._zero_base import ZeROOptimizer, ZeROState
 from apex_tpu.contrib.optimizers.distributed_fused_adam import DistributedFusedAdam
@@ -16,4 +20,29 @@ __all__ = [
     "ZeROState",
     "DistributedFusedAdam",
     "DistributedFusedLAMB",
+    "FusedAdam",
+    "FusedLAMB",
+    "FusedSGD",
+    "FP16_Optimizer",
 ]
+
+_LEGACY = {
+    "FusedAdam": ("apex_tpu.optimizers", "FusedAdam"),
+    "FusedLAMB": ("apex_tpu.optimizers", "FusedLAMB"),
+    "FusedSGD": ("apex_tpu.optimizers", "FusedSGD"),
+    "FP16_Optimizer": ("apex_tpu.fp16_utils", "FP16Optimizer"),
+}
+
+
+def __getattr__(name):
+    if name in _LEGACY:
+        module_name, attr = _LEGACY[name]
+        _warnings.warn(
+            f"apex_tpu.contrib.optimizers.{name} is the deprecated contrib "
+            f"duplicate; use {module_name}.{attr}",
+            DeprecationWarning, stacklevel=2)
+        import importlib
+
+        return getattr(importlib.import_module(module_name), attr)
+    raise AttributeError(
+        f"module 'apex_tpu.contrib.optimizers' has no attribute {name!r}")

@@ -155,14 +155,22 @@ import numpy as np
 from apex_tpu.utils.packing import PackedSpec
 
 
-def segment_ids_for_spec(spec: PackedSpec) -> jnp.ndarray:
-    """Leaf index per flat element; padding gets the dead segment
-    ``spec.num_leaves`` (dropped by ``num_segments``-bounded reductions)."""
-    ids = np.full((spec.padded_total,), spec.num_leaves, np.int32)
-    for i, (shape, offset) in enumerate(zip(spec.shapes, spec.offsets)):
+@functools.lru_cache(maxsize=64)
+def _segment_ids_cached(shapes, offsets, padded_total, num_leaves):
+    ids = np.full((padded_total,), num_leaves, np.int32)
+    for i, (shape, offset) in enumerate(zip(shapes, offsets)):
         size = int(np.prod(shape)) if len(shape) else 1
         ids[offset:offset + size] = i
     return jnp.asarray(ids)
+
+
+def segment_ids_for_spec(spec: PackedSpec) -> jnp.ndarray:
+    """Leaf index per flat element; padding gets the dead segment
+    ``spec.num_leaves`` (dropped by ``num_segments``-bounded reductions).
+    Cached per layout: the spec is static, so eager per-step callers must
+    not rebuild (and re-upload) an O(total-params) array every step."""
+    return _segment_ids_cached(spec.shapes, spec.offsets, spec.padded_total,
+                               spec.num_leaves)
 
 
 def _segment_sqnorm(x32, seg_ids, num_segments):

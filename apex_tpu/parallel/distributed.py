@@ -45,6 +45,7 @@ def allreduce_grads(
 
     axis_size = jax.lax.psum(1, axis_name)
 
+    @jax.named_scope("apex_tpu.allreduce_grads")  # nvtx range parity
     def reduce_leaf(g):
         orig_dtype = g.dtype
         if allreduce_always_fp32:

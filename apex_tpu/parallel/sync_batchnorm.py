@@ -43,6 +43,13 @@ def sync_batch_stats(x: jax.Array, channel_axis: int = -1,
     contrib GBN/bnp ``bn_group`` semantics (stats shared by groups of
     ``bn_group`` adjacent ranks rather than the whole world).
     """
+    # named_scope = the reference's NVTX range (sync_batchnorm.py:71-134)
+    with jax.named_scope("apex_tpu.sync_batch_stats"):
+        return _batch_stats_impl(x, channel_axis, axis_name,
+                                 axis_index_groups)
+
+
+def _batch_stats_impl(x, channel_axis, axis_name, axis_index_groups):
     x32 = x.astype(jnp.float32)
     axes = tuple(i for i in range(x.ndim) if i != channel_axis % x.ndim)
     n_local = 1

@@ -63,6 +63,7 @@ class ParallelMLP(nn.Module):
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
+    @jax.named_scope("parallel_mlp")
     def __call__(self, x):
         ffn = self.ffn_hidden_size or 4 * self.hidden_size
         h, bias = ColumnParallelLinear(
@@ -100,6 +101,7 @@ class ParallelAttention(nn.Module):
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
+    @jax.named_scope("parallel_attention")
     def __call__(self, x, attention_mask=None, deterministic: bool = True,
                  segment_ids=None):
         # x: [s, b, h]

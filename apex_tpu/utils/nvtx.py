@@ -1,0 +1,50 @@
+"""Trace annotation ranges (the NVTX surface, TPU-backed).
+
+Parity target: the reference's hand-inserted NVTX ranges
+(apex/parallel/distributed.py:364, sync_batchnorm.py:71-134, and the
+``--prof`` window of examples/imagenet/main_amp.py:360).
+
+TPU design: one annotation does two jobs —
+- ``jax.named_scope`` labels the *traced* ops so the region survives into
+  the XLA profile (what nvtx gives nsight), and
+- ``jax.profiler.TraceAnnotation`` marks host wall-time spans for the
+  TensorBoard trace viewer (what nvtx gives the CPU timeline).
+
+``range_push``/``range_pop`` mirror ``torch.cuda.nvtx`` so ported scripts
+keep working; prefer the :func:`range` context manager in new code.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, List
+
+import jax
+
+__all__ = ["range", "range_push", "range_pop"]
+
+_stack: List = []
+
+
+@contextlib.contextmanager
+def range(name: str) -> Iterator[None]:  # noqa: A001 - nvtx API name
+    """Label everything traced inside with ``name`` (device + host)."""
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def range_push(name: str) -> int:
+    """torch.cuda.nvtx.range_push parity; returns the new stack depth."""
+    cm = range(name)
+    cm.__enter__()
+    _stack.append(cm)
+    return len(_stack)
+
+
+def range_pop() -> int:
+    """torch.cuda.nvtx.range_pop parity; returns the depth popped from."""
+    if not _stack:
+        raise RuntimeError("range_pop without a matching range_push")
+    depth = len(_stack)
+    _stack.pop().__exit__(None, None, None)
+    return depth

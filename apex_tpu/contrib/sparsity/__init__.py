@@ -1,4 +1,12 @@
 from apex_tpu.contrib.sparsity.asp import ASP
+from apex_tpu.contrib.sparsity.permutation_search import (
+    accelerated_search_for_good_permutation,
+    apply_permutation,
+    invert_permutation,
+    sum_after_2_to_4,
+)
 from apex_tpu.contrib.sparsity.sparse_masklib import create_mask
 
-__all__ = ["ASP", "create_mask"]
+__all__ = ["ASP", "create_mask",
+           "accelerated_search_for_good_permutation", "apply_permutation",
+           "invert_permutation", "sum_after_2_to_4"]

@@ -1,0 +1,113 @@
+"""Input-channel permutation search for 2:4 structured sparsity.
+
+Parity target: ``apex.contrib.sparsity.permutation_search_kernels``
+(channel_swap.py:1-200, permutation_utilities.py:44-115): permuting a
+weight matrix's input channels before applying the n:m mask can keep
+large-magnitude weights that a fixed channel order would prune; the
+reference searches with greedy channel swaps (plus CUDA-brute-forced
+exhaustive stripe checks).
+
+TPU scope: the *search* runs offline on the host — there is no kernel to
+feed, so this module keeps the algorithmic contract (greedy swap descent
+on retained magnitude, deterministic, identity when nothing improves) in
+vectorized numpy: each round evaluates every cross-stripe column swap
+with one batched [pairs, 16, rows, 4] top-2 reduction.  The reference's
+model-graph plumbing (permutation_lib.py, ~4.8k LoC of FX-graph analysis
+that propagates the permutation through residual skeletons) is
+PyTorch-FX-specific and out of scope; apply the returned permutation to
+your own parameter pytree with :func:`apply_permutation` / its inverse on
+the producing layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["sum_after_2_to_4", "accelerated_search_for_good_permutation",
+           "apply_permutation", "invert_permutation"]
+
+
+def _retained(groups: np.ndarray) -> np.ndarray:
+    """Retained |magnitude| after 2:4 pruning of a [..., 4]-grouped view,
+    reduced over the trailing two axes (rows, 4) — the ONE implementation
+    of the keep rule (permutation_utilities.py:44-79), fp32 throughout."""
+    g = np.abs(groups.astype(np.float32, copy=False))
+    kept = g.sum(axis=(-1, -2)) - np.sort(g, axis=-1)[..., :2].sum(axis=(-1, -2))
+    return kept
+
+
+def sum_after_2_to_4(matrix: np.ndarray) -> float:
+    """Total |magnitude| retained by 2:4 pruning along the last axis."""
+    m = np.asarray(matrix)
+    if m.shape[-1] % 4:
+        raise ValueError(f"columns ({m.shape[-1]}) must be a multiple of 4")
+    return float(_retained(m.reshape(-1, 1, 4)).sum())
+
+
+def accelerated_search_for_good_permutation(
+        matrix, options: Optional[dict] = None
+) -> np.ndarray:
+    """Greedy channel-swap descent (channel_swap.py:177-200).
+
+    Returns a permutation ``perm`` of the input channels such that
+    ``matrix[:, perm]`` retains at least as much magnitude under 2:4
+    pruning as ``matrix``; identity when no swap helps.  Deterministic:
+    each round applies the single best improving cross-stripe swap.
+    """
+    options = options or {}
+    max_rounds = int(options.get("max_rounds", 1000))
+    m = np.array(np.asarray(matrix, np.float32).reshape(
+        -1, np.asarray(matrix).shape[-1]), copy=True)
+    rows, cols = m.shape
+    if cols % 4:
+        raise ValueError(f"columns ({cols}) must be a multiple of 4")
+    n_stripes = cols // 4
+    perm = np.arange(cols)
+    if n_stripes < 2:
+        return perm
+
+    pair_a, pair_b = np.triu_indices(n_stripes, k=1)     # [P] stripe pairs
+    ci, cj = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+    ci, cj = ci.ravel(), cj.ravel()                      # 16 swap combos
+
+    for _ in range(max_rounds):
+        stripes = np.abs(m).reshape(rows, n_stripes, 4).transpose(1, 0, 2)
+        base = _retained(stripes)                        # [stripes]
+
+        # candidate stripes after each swap: [P, 16, rows, 4]
+        sa = np.broadcast_to(stripes[pair_a, None],
+                             (len(pair_a), 16, rows, 4)).copy()
+        sb = np.broadcast_to(stripes[pair_b, None],
+                             (len(pair_b), 16, rows, 4)).copy()
+        # column exchange per combo: 16 iterations, each vectorized over
+        # all stripe pairs and rows
+        for idx in range(16):
+            sa[:, idx, :, ci[idx]] = stripes[pair_b][:, :, cj[idx]]
+            sb[:, idx, :, cj[idx]] = stripes[pair_a][:, :, ci[idx]]
+
+        gains = (_retained(sa) + _retained(sb)
+                 - base[pair_a, None] - base[pair_b, None])  # [P, 16]
+        flat = int(np.argmax(gains))
+        best_gain = gains.ravel()[flat]
+        if best_gain <= 1e-6:
+            break
+        p_idx, combo = divmod(flat, 16)
+        i = pair_a[p_idx] * 4 + ci[combo]
+        j = pair_b[p_idx] * 4 + cj[combo]
+        m[:, [i, j]] = m[:, [j, i]]
+        perm[[i, j]] = perm[[j, i]]
+    return perm
+
+
+def apply_permutation(matrix, perm, axis: int = -1):
+    """Reorder channels; the producing layer applies the inverse on its
+    output dimension so the network function is unchanged."""
+    return np.take(np.asarray(matrix), perm, axis=axis)
+
+
+def invert_permutation(perm: np.ndarray) -> np.ndarray:
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(len(perm))
+    return inv

@@ -215,9 +215,6 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
             if tracing and step == 10:
                 jax.profiler.stop_trace()
                 tracing = False
-        if tracing:
-            # run ended inside the trace window; finalize the trace
-            jax.profiler.stop_trace()
             if step == start_step + 1:  # skip compile
                 jax.block_until_ready(params)
                 t0 = time.perf_counter()
@@ -225,6 +222,9 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
                     (step + 1) % save_interval == 0:
                 save_checkpoint(save, step + 1, params, batch_stats,
                                 opt_state, scaler_state)
+        if tracing:
+            # run ended inside the trace window; finalize the trace
+            jax.profiler.stop_trace()
         jax.block_until_ready(params)
         ran = steps - start_step
         if ran > 2 and t0 is not None:

@@ -122,6 +122,42 @@ def test_asp_name_filters():
     assert "encoder/w" in masks and "head/w" not in masks
 
 
+def test_permutation_search_improves_retained_magnitude():
+    from apex_tpu.contrib.sparsity import (
+        accelerated_search_for_good_permutation,
+        apply_permutation,
+        invert_permutation,
+        sum_after_2_to_4,
+    )
+
+    # adversarial layout: each stripe holds equal-magnitude columns, so 2:4
+    # must prune large entries; mixing stripes recovers magnitude
+    rng = np.random.default_rng(7)
+    big = np.abs(rng.standard_normal((16, 4))) + 10.0
+    small = np.abs(rng.standard_normal((16, 4))) * 0.1
+    w = np.concatenate([big, small], axis=1)  # stripe0 all-big, stripe1 all-small
+
+    base = sum_after_2_to_4(w)
+    perm = accelerated_search_for_good_permutation(w)
+    permuted = apply_permutation(w, perm)
+    assert sum_after_2_to_4(permuted) > base
+    # permutation is a bijection and invertible
+    assert sorted(perm) == list(range(8))
+    inv = invert_permutation(perm)
+    np.testing.assert_array_equal(apply_permutation(permuted, inv), w)
+
+
+def test_permutation_search_identity_when_nothing_helps():
+    from apex_tpu.contrib.sparsity import (
+        accelerated_search_for_good_permutation,
+    )
+
+    # all-equal magnitudes: no swap can improve retained magnitude
+    w = np.ones((8, 8), np.float32)
+    perm = accelerated_search_for_good_permutation(w)
+    np.testing.assert_array_equal(perm, np.arange(8))
+
+
 def test_asp_double_init_raises():
     params = {"w": jnp.ones((16, 8))}
     ASP.init_model_for_pruning(params, verbosity=0)

@@ -13,6 +13,7 @@ from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType, ModelT
 _SUBMODULES = (
     "tensor_parallel",
     "pipeline_parallel",
+    "context_parallel",
     "functional",
     "layers",
     "amp",

@@ -1,0 +1,135 @@
+"""Ring attention — context parallelism for long sequences.
+
+Capability target: the reference scales long sequences with its fused
+attention + sequence-parallel machinery; the TPU-native answer for
+sequences too long for one chip is *context parallelism*: shard the
+sequence over a mesh axis and rotate key/value blocks around the ring
+(Ring Attention, Liu et al. 2023), so each chip only ever holds
+``s_local = s / cp`` keys at a time — online softmax keeps attention
+memory free of any [s, s] term and the KV transfers ride ICI neighbor
+links.
+
+Design:
+- one ``lax.fori``-style scan over ``cp`` ring steps; the carry is the
+  online-softmax state (running max, normalizer, weighted accumulator)
+  plus the in-flight KV block; each step ends with a neighbor
+  ``ppermute`` — exactly the flash-attention accumulation pattern, with
+  blocks arriving over the wire instead of from HBM.
+- causal masking is block-level: a KV block from a later ring position is
+  skipped outright, the diagonal block gets the in-block causal mask,
+  earlier blocks attend fully — no [s, s] score matrix ever exists.
+- backward: JAX differentiates the scan/ppermute (cotangents traverse the
+  reverse ring); with ``jax.checkpoint`` around the per-step kernel, the
+  saved state is O(cp · block) wire tensors, the ring-attention memory
+  bound.
+
+Compose with tp (heads) and dp (batch) freely: cp only owns the sequence
+axis, e.g. ``Mesh(..., ("dp", "cp", "tp"))``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ring_self_attention", "ring_attention"]
+
+_NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, *, scale, mask):
+    """Unnormalized block attention: returns (scores_max, exp-sum, o_partial)
+    with fp32 accumulation; mask is [sq, sk] bool or None."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [b,h,sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                                   # [b,h,sq]
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Attention over a sequence sharded on ``axis_name``.
+
+    q/k/v: local shards ``[b, h, s_local, d]`` (rank r holds global
+    positions ``[r*s_local, (r+1)*s_local)``).  Returns the local output
+    shard ``[b, h, s_local, d]`` in q's dtype; numerics match dense
+    attention over the gathered sequence.
+    """
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    ring_perm = [(i, (i + 1) % n) for i in range(n)]  # KV moves rank i -> i+1
+
+    tri = jnp.tril(jnp.ones((s_local, s_local), bool)) if causal else None
+
+    @jax.checkpoint
+    def step_math(q, k_blk, v_blk, src, m_acc, l_acc, o_acc):
+        """One block accumulation; src is the block's origin rank (traced).
+
+        Block-level causal structure: src > my → block fully masked;
+        src == my → in-block triangle; src < my → full attention.  One
+        _block_attend with a dynamically selected mask covers all three.
+        """
+        mask = None
+        if causal:
+            mask = jnp.logical_or(tri, src != my)  # triangle only on-diag
+        m_blk, l_blk, o_blk = _block_attend(q, k_blk, v_blk, scale=scale,
+                                            mask=mask)
+        if causal:
+            dead = src > my
+            m_blk = jnp.where(dead, _NEG_INF, m_blk)
+            l_blk = jnp.where(dead, 0.0, l_blk)
+            o_blk = jnp.where(dead, 0.0, o_blk)
+
+        # online-softmax merge
+        m_new = jnp.maximum(m_acc, m_blk)
+        a = jnp.exp(m_acc - m_new)
+        bfac = jnp.exp(m_blk - m_new)
+        l_new = l_acc * a + l_blk * bfac
+        o_new = o_acc * a[..., None] + o_blk * bfac[..., None]
+        return m_new, l_new, o_new
+
+    # step 0 attends the local block (no transfer); steps 1..n-1 each
+    # rotate KV one hop then attend — n-1 total transfers, none wasted.
+    # src of the block held after r rotations is (my - r) mod n: pure
+    # arithmetic, not a collective.
+    m_acc = jnp.full((b, h, s_local), _NEG_INF, jnp.float32)
+    l_acc = jnp.zeros((b, h, s_local), jnp.float32)
+    o_acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+    m_acc, l_acc, o_acc = step_math(q, k, v, my, m_acc, l_acc, o_acc)
+
+    def ring_step(carry, r):
+        k_blk, v_blk, m_acc, l_acc, o_acc = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, ring_perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, ring_perm)
+        src = jnp.mod(my - r, n)
+        m_acc, l_acc, o_acc = step_math(q, k_blk, v_blk, src,
+                                        m_acc, l_acc, o_acc)
+        return (k_blk, v_blk, m_acc, l_acc, o_acc), None
+
+    if n > 1:
+        (_, _, m_acc, l_acc, o_acc), _ = jax.lax.scan(
+            ring_step, (k, v, m_acc, l_acc, o_acc), jnp.arange(1, n))
+
+    # fully-masked rows (none in self-attention, defensive) give zeros
+    safe_l = jnp.where(l_acc == 0.0, 1.0, l_acc)
+    return (o_acc / safe_l[..., None]).astype(q.dtype)
+
+
+def ring_self_attention(qkv, *, axis_name: str, causal: bool = True,
+                        scale: Optional[float] = None):
+    """Convenience for fused qkv ``[b, h, s_local, 3, d]``."""
+    q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+    return ring_attention(q, k, v, axis_name=axis_name, causal=causal,
+                          scale=scale)

@@ -14,6 +14,7 @@ _SUBMODULES = (
     "tensor_parallel",
     "pipeline_parallel",
     "context_parallel",
+    "moe",
     "functional",
     "layers",
     "amp",

@@ -1,0 +1,136 @@
+"""Mixture-of-Experts with expert parallelism over a mesh axis.
+
+Capability target: expert parallelism ("ep") as a first-class sharding —
+experts live sharded across ranks and tokens travel to their expert via
+``all_to_all``, the standard TPU MoE dataflow (GShard/Switch): gate →
+capacity-bounded dispatch einsum → all_to_all over ``ep`` → batched
+expert FFN on the MXU → all_to_all back → weighted combine.  (NVIDIA
+Apex predates MoE and has no counterpart; this rounds out the dp/tp/pp/
+sp/ep sharding set the framework targets.)
+
+Design notes:
+- dispatch/combine are dense einsums against a [tokens, experts,
+  capacity] one-hot — no dynamic shapes, so XLA can tile everything;
+  tokens over capacity are dropped and their outputs pass through as
+  zeros scaled into the residual (Switch semantics).
+- the router computes in fp32 regardless of activation dtype; an
+  auxiliary load-balancing loss (Switch eq. 4) is returned alongside.
+- with ``axis_name=None`` the same module runs single-rank (all experts
+  local) — the parity oracle for the sharded path *while capacity does
+  not bind*.  When it binds, drops differ by design: the sharded path
+  cuts each rank's local queue (capacity slots per rank per expert, the
+  GShard dataflow), the local path cuts one global queue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+__all__ = ["ExpertParallelMLP", "top1_dispatch"]
+
+
+def top1_dispatch(logits32, capacity: int):
+    """Switch-style top-1 routing with position-in-expert capacity.
+
+    logits32: [tokens, experts] fp32.  Returns (dispatch [t, e, c] float,
+    combine [t, e, c] float, aux_loss scalar).
+    """
+    t, e = logits32.shape
+    probs = jax.nn.softmax(logits32, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # [t]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [t, e]
+
+    # position of each token within its chosen expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0        # [t, e]
+    in_cap = (pos >= 0) & (pos < capacity)
+    dispatch = onehot[..., None] * jax.nn.one_hot(
+        jnp.maximum(pos, 0.0).astype(jnp.int32), capacity,
+        dtype=jnp.float32) * in_cap[..., None]             # [t, e, c]
+    gate = jnp.sum(probs * onehot, axis=-1)                # [t]
+    combine = dispatch * gate[:, None, None]
+
+    # Switch load-balancing loss: e * sum_e(frac_tokens_e * frac_prob_e)
+    frac_tokens = jnp.mean(onehot, axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+class ExpertParallelMLP(nn.Module):
+    """Top-1 MoE FFN; experts sharded over ``axis_name`` when set.
+
+    Input ``[tokens, hidden]`` (flatten batch/sequence first); returns
+    ``(output [tokens, hidden], aux_loss)``.  Under shard_map each rank
+    holds ``num_experts / ep`` experts and its own token shard.
+    """
+
+    num_experts: int
+    hidden_size: int
+    ffn_hidden_size: Optional[int] = None
+    capacity_factor: float = 1.25
+    axis_name: Optional[str] = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
+        t, h = x.shape
+        ffn = self.ffn_hidden_size or 4 * h
+        ep = (jax.lax.axis_size(self.axis_name)
+              if self.axis_name is not None else 1)
+        if self.num_experts % ep:
+            raise ValueError(f"num_experts ({self.num_experts}) must divide "
+                             f"by the ep axis size ({ep})")
+        local_e = self.num_experts // ep
+        # per-rank slots per expert: the GShard/Switch bound — each expert
+        # receives ep * capacity = cf * t_global / num_experts slots total,
+        # so per-expert compute and all_to_all bytes stay flat as ep grows
+        capacity = max(1, int(self.capacity_factor * t / self.num_experts))
+
+        router = self.param("router", nn.initializers.lecun_normal(),
+                            (h, self.num_experts), jnp.float32)
+        # local experts only: [local_e, h, ffn] / [local_e, ffn, h]
+        w_in = self.param("w_in", nn.initializers.lecun_normal(),
+                          (local_e, h, ffn), self.param_dtype)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(),
+                           (local_e, ffn, h), self.param_dtype)
+
+        logits = x.astype(jnp.float32) @ router
+        dispatch, combine, aux = top1_dispatch(logits, capacity)
+
+        # [t, e, c] x [t, h] -> [e, c, h]: the dispatch einsum
+        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), x)
+
+        if self.axis_name is not None:
+            # rows [e, ...] regroup so each rank receives ITS experts'
+            # slots from every rank: [e, c, h] -> [local_e, ep*c, h]
+            expert_in = expert_in.reshape(ep, local_e, capacity, h)
+            expert_in = jax.lax.all_to_all(
+                expert_in, self.axis_name, split_axis=0, concat_axis=0,
+                tiled=False)
+            expert_in = expert_in.transpose(1, 0, 2, 3).reshape(
+                local_e, ep * capacity, h)
+        else:
+            expert_in = expert_in.reshape(local_e, capacity, h)
+
+        # batched expert FFN: one [local_e] batched MXU matmul pair
+        hmid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                      w_in.astype(x.dtype)))
+        expert_out = jnp.einsum("ecf,efh->ech", hmid, w_out.astype(x.dtype))
+
+        if self.axis_name is not None:
+            expert_out = expert_out.reshape(local_e, ep, capacity, h)
+            expert_out = expert_out.transpose(1, 0, 2, 3)
+            expert_out = jax.lax.all_to_all(
+                expert_out, self.axis_name, split_axis=0, concat_axis=0,
+                tiled=False)
+            expert_out = expert_out.reshape(self.num_experts, capacity, h)
+        else:
+            expert_out = expert_out.reshape(self.num_experts, capacity, h)
+
+        out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+        return out, aux

@@ -1,0 +1,90 @@
+"""Expert-parallel MoE: sharded all_to_all path vs the local oracle."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from apex_tpu.transformer.moe import ExpertParallelMLP, top1_dispatch
+
+
+def test_top1_dispatch_capacity_and_loss():
+    logits = jnp.asarray([[5.0, 0.0], [4.0, 0.0], [3.0, 0.0], [0.0, 2.0]],
+                         jnp.float32)
+    dispatch, combine, aux = top1_dispatch(logits, capacity=2)
+    d = np.asarray(dispatch)
+    # tokens 0,1 fill expert 0's two slots; token 2 dropped (over capacity)
+    assert d[0, 0, 0] == 1 and d[1, 0, 1] == 1
+    assert d[2].sum() == 0
+    assert d[3, 1, 0] == 1
+    # combine carries the gate probability
+    probs = np.asarray(jax.nn.softmax(logits, -1))
+    np.testing.assert_allclose(np.asarray(combine)[0, 0, 0], probs[0, 0],
+                               rtol=1e-6)
+    assert float(aux) > 0
+
+
+def test_moe_local_forward_and_grads():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+    m = ExpertParallelMLP(num_experts=4, hidden_size=16, ffn_hidden_size=32,
+                          capacity_factor=2.0)
+    params = m.init(jax.random.PRNGKey(0), x)
+    out, aux = m.apply(params, x)
+    assert out.shape == x.shape
+    grads = jax.grad(lambda p: m.apply(p, x)[0].sum() + m.apply(p, x)[1])(
+        params)
+    assert all(np.all(np.isfinite(l)) for l in jax.tree.leaves(grads))
+    assert np.abs(np.asarray(
+        grads["params"]["router"])).max() > 0  # router learns
+
+
+def test_expert_parallel_matches_local():
+    """The ep-sharded all_to_all path must equal the single-rank oracle.
+
+    capacity_factor=4 keeps capacity from binding: with drops the two
+    paths cut different queues (per-rank vs global — see moe.py docstring)
+    and parity intentionally does not hold."""
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("ep",))
+    rng = np.random.default_rng(1)
+    tokens_per_rank, h = 16, 8
+    x = jnp.asarray(rng.standard_normal((4 * tokens_per_rank, h)),
+                    jnp.float32)
+
+    local = ExpertParallelMLP(num_experts=4, hidden_size=h,
+                              ffn_hidden_size=16, capacity_factor=4.0,
+                              axis_name=None)
+    sharded = ExpertParallelMLP(num_experts=4, hidden_size=h,
+                                ffn_hidden_size=16, capacity_factor=4.0,
+                                axis_name="ep")
+    params = local.init(jax.random.PRNGKey(0), x)
+
+    # oracle: all experts local, all tokens at once
+    want, _ = local.apply(params, x)
+
+    def fn(x_shard, full_params):
+        # each rank keeps its token shard and its expert slice
+        ep = jax.lax.axis_size("ep")
+        r = jax.lax.axis_index("ep")
+        local_e = 4 // ep
+        slice_p = {
+            "params": {
+                "router": full_params["params"]["router"],
+                "w_in": jax.lax.dynamic_slice_in_dim(
+                    full_params["params"]["w_in"], r * local_e, local_e, 0),
+                "w_out": jax.lax.dynamic_slice_in_dim(
+                    full_params["params"]["w_out"], r * local_e, local_e, 0),
+            }
+        }
+        out, aux = sharded.apply(slice_p, x_shard)
+        return out
+
+    with mesh:
+        got = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("ep"), P()),
+                                out_specs=P("ep"), check_vma=False))(
+            x, params)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)

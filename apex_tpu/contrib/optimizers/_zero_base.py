@@ -143,17 +143,13 @@ class ZeROOptimizer:
                 if self.distributed_axis else 0)
         return spec, n, shard, rank
 
-    def _segment_ids(self, spec) -> np.ndarray:
-        """Static element -> parameter-index map over the padded flat buffer
-        (padding gets the sentinel id ``num_leaves``)."""
-        ids = np.full((spec.padded_total,), spec.num_leaves, np.int32)
-        for i, (shape, off) in enumerate(zip(spec.shapes, spec.offsets)):
-            size = int(np.prod(shape)) if len(shape) else 1
-            ids[off:off + size] = i
-        return ids
-
     def _shard_segment_ids(self, spec, shard: int, rank) -> jax.Array:
-        ids = jnp.asarray(self._segment_ids(spec))
+        """Element -> parameter-index map for this rank's shard, generated
+        on device (a host-side id array would bake an O(total-params)
+        constant into the program — see ops.packed_update)."""
+        from apex_tpu.ops.packed_update import segment_ids_for_spec
+
+        ids = segment_ids_for_spec(spec)
         return jax.lax.dynamic_slice(ids, (rank * shard,), (shard,))
 
     def _param_sync_dtype_for(self, spec):

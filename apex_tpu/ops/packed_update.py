@@ -155,22 +155,34 @@ import numpy as np
 from apex_tpu.utils.packing import PackedSpec
 
 
-@functools.lru_cache(maxsize=64)
-def _segment_ids_cached(shapes, offsets, padded_total, num_leaves):
-    ids = np.full((padded_total,), num_leaves, np.int32)
-    for i, (shape, offset) in enumerate(zip(shapes, offsets)):
-        size = int(np.prod(shape)) if len(shape) else 1
-        ids[offset:offset + size] = i
-    return jnp.asarray(ids)
-
-
 def segment_ids_for_spec(spec: PackedSpec) -> jnp.ndarray:
     """Leaf index per flat element; padding gets the dead segment
     ``spec.num_leaves`` (dropped by ``num_segments``-bounded reductions).
-    Cached per layout: the spec is static, so eager per-step callers must
-    not rebuild (and re-upload) an O(total-params) array every step."""
-    return _segment_ids_cached(spec.shapes, spec.offsets, spec.padded_total,
-                               spec.num_leaves)
+
+    Computed ON DEVICE from the tiny per-leaf boundary table
+    (searchsorted over an iota): materializing the O(total-params) id
+    array on the host would embed a multi-GB constant in the compiled
+    program — large enough to break remote-compile transports — and cost
+    a host->device upload per eager step.
+    """
+    if spec.padded_total >= 2 ** 31:
+        raise NotImplementedError(
+            f"packed buffer of {spec.padded_total} elements exceeds int32 "
+            "segment-id range; shard the parameters (ZeRO) below 2**31 "
+            "elements per buffer")
+    # boundary[i] = end offset of leaf i; elements past the last boundary
+    # (padding) land at index num_leaves.  searchsorted assumes leaves are
+    # contiguous — assert against spec.offsets (the layout's source of
+    # truth) so a future gapped layout fails loudly, not silently.
+    ends = np.asarray(spec.offsets) + np.asarray(spec.sizes)
+    if spec.num_leaves and not np.array_equal(
+            np.asarray(spec.offsets)[1:], ends[:-1]):
+        raise ValueError("segment_ids_for_spec requires a contiguous "
+                         "packed layout (offsets must tile sizes)")
+    boundaries = jnp.asarray(ends, jnp.int32)
+    return jnp.searchsorted(boundaries,
+                            jnp.arange(spec.padded_total, dtype=jnp.int32),
+                            side="right").astype(jnp.int32)
 
 
 def _segment_sqnorm(x32, seg_ids, num_segments):

@@ -52,6 +52,11 @@ class PackedSpec:
     def num_leaves(self) -> int:
         return len(self.shapes)
 
+    @property
+    def sizes(self) -> tuple[int, ...]:
+        """Element count per leaf — the one definition of leaf size."""
+        return tuple(int(np.prod(s)) if len(s) else 1 for s in self.shapes)
+
 
 @dataclasses.dataclass
 class PackedBuffer:
@@ -72,7 +77,7 @@ def make_packed_spec(tree: Any, pad_to: int = 1024) -> PackedSpec:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     shapes = tuple(tuple(l.shape) for l in leaves)
     dtypes = tuple(l.dtype for l in leaves)
-    sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]
+    sizes = [int(np.prod(s)) if len(s) else 1 for s in shapes]  # = spec.sizes
     offsets = tuple(int(o) for o in np.cumsum([0] + sizes)[:-1])
     total = int(sum(sizes))
     padded_total = ((total + pad_to - 1) // pad_to) * pad_to if total else pad_to

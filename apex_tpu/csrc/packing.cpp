@@ -1,0 +1,49 @@
+// apex_tpu native host runtime: flatten/unflatten for packed buffers.
+//
+// Parity target: apex_C (csrc/flatten_unflatten.cpp:16-17) — the C++
+// extension behind DDP bucketing and multi-tensor packing.  On TPU the
+// device-side packing is XLA's job (utils/packing.py), but the HOST side
+// — assembling checkpoint shards, staging numpy training data into one
+// pinned buffer, unpacking restored flat buffers — is memcpy-bound
+// Python-loop territory, which is exactly what the reference moved to
+// C++.  Exposed through ctypes (no pybind11 in this environment).
+//
+// Build: compiled on first use by apex_tpu.utils._native (g++ -O3
+// -shared -fPIC); falls back to numpy if no toolchain is present.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Copy n_leaves separate host buffers into one contiguous flat buffer.
+// srcs: array of source pointers; sizes: per-leaf byte counts;
+// dst: destination of capacity >= sum(sizes).  Returns bytes written.
+int64_t apex_tpu_flatten(const void **srcs, const int64_t *sizes,
+                         int64_t n_leaves, void *dst) {
+  char *out = static_cast<char *>(dst);
+  int64_t off = 0;
+  for (int64_t i = 0; i < n_leaves; ++i) {
+    std::memcpy(out + off, srcs[i], static_cast<size_t>(sizes[i]));
+    off += sizes[i];
+  }
+  return off;
+}
+
+// Inverse: scatter one flat buffer back into n_leaves destinations.
+int64_t apex_tpu_unflatten(const void *src, const int64_t *sizes,
+                           int64_t n_leaves, void **dsts) {
+  const char *in = static_cast<const char *>(src);
+  int64_t off = 0;
+  for (int64_t i = 0; i < n_leaves; ++i) {
+    std::memcpy(dsts[i], in + off, static_cast<size_t>(sizes[i]));
+    off += sizes[i];
+  }
+  return off;
+}
+
+// Version tag so the loader can detect stale cached builds.
+int32_t apex_tpu_native_abi(void) { return 1; }
+
+}  // extern "C"

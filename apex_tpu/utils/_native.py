@@ -1,0 +1,112 @@
+"""Loader for the native host runtime (csrc/packing.cpp).
+
+Compiles the C++ source once into a per-user cached shared object and
+binds it through ctypes (this environment has no pybind11; ctypes is the
+zero-dependency binding path).  Everything degrades gracefully: with no
+toolchain or a failed build, ``lib()`` returns None and callers use their
+numpy fallbacks — the same contract as the reference's optional
+``--cpp_ext`` build.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_ABI = 1
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_SRC = Path(__file__).resolve().parent.parent / "csrc" / "packing.cpp"
+
+
+def _cache_dir() -> Path:
+    # user-private cache (0700, ownership verified): a predictable /tmp
+    # path would let another local user pre-plant a .so that CDLL executes
+    default = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "apex_tpu_native")
+    path = Path(os.environ.get("APEX_TPU_CACHE", default))
+    path.mkdir(parents=True, exist_ok=True, mode=0o700)
+    stat = path.stat()
+    if stat.st_uid != os.getuid():
+        raise RuntimeError(f"native cache dir {path} is not owned by the "
+                           "current user; refusing to load code from it")
+    os.chmod(path, 0o700)
+    return path
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    src = _SRC.read_text()
+    tag = hashlib.sha256(src.encode()).hexdigest()[:16]
+    so_path = _cache_dir() / f"packing_{tag}.so"
+    if not so_path.exists():
+        # per-process tmp name: concurrent cold-cache builders must not
+        # interleave writes into one file before the atomic replace
+        tmp = so_path.with_suffix(f".build{os.getpid()}.so")
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+               str(_SRC), "-o", str(tmp)]
+        result = subprocess.run(cmd, capture_output=True, text=True,
+                                timeout=120)
+        if result.returncode != 0:
+            return None
+        os.replace(tmp, so_path)
+    lib = ctypes.CDLL(str(so_path))
+    lib.apex_tpu_native_abi.restype = ctypes.c_int32
+    if lib.apex_tpu_native_abi() != _ABI:
+        return None
+    lib.apex_tpu_flatten.restype = ctypes.c_int64
+    lib.apex_tpu_flatten.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_void_p]
+    lib.apex_tpu_unflatten.restype = ctypes.c_int64
+    lib.apex_tpu_unflatten.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p)]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if not _tried:
+        _tried = True
+        try:
+            _lib = _build()
+        except Exception:
+            _lib = None
+    return _lib
+
+
+def flatten_into(arrays, out) -> int:
+    """memcpy every contiguous numpy array in ``arrays`` into ``out``
+    (1-D, matching total nbytes).  Returns bytes written; raises
+    RuntimeError when the native library is unavailable."""
+    native = lib()
+    if native is None:
+        raise RuntimeError("native runtime unavailable")
+    n = len(arrays)
+    srcs = (ctypes.c_void_p * n)(
+        *[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    return native.apex_tpu_flatten(srcs, sizes, n,
+                                   ctypes.c_void_p(out.ctypes.data))
+
+
+def unflatten_from(flat, arrays) -> int:
+    """Inverse of :func:`flatten_into`: scatter ``flat``'s bytes into the
+    pre-allocated contiguous numpy ``arrays``."""
+    native = lib()
+    if native is None:
+        raise RuntimeError("native runtime unavailable")
+    n = len(arrays)
+    dsts = (ctypes.c_void_p * n)(*[a.ctypes.data for a in arrays])
+    sizes = (ctypes.c_int64 * n)(*[a.nbytes for a in arrays])
+    return native.apex_tpu_unflatten(ctypes.c_void_p(flat.ctypes.data),
+                                     sizes, n, dsts)

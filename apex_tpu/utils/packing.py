@@ -37,6 +37,56 @@ def unflatten_dense_tensors(flat: jax.Array, like: Sequence[jax.Array]) -> list[
     ]
 
 
+def host_flatten_dense_tensors(arrays: Sequence[np.ndarray]) -> np.ndarray:
+    """Host-side apex_C.flatten: pack numpy arrays (checkpoint shards,
+    staged batches) into one contiguous buffer via the C++ runtime
+    (csrc/packing.cpp), numpy fallback when no toolchain exists.
+
+    All arrays must share a dtype; non-contiguous inputs are copied.
+    """
+    from apex_tpu.utils import _native
+
+    if not arrays:
+        return np.empty((0,), np.float32)
+    dtype = arrays[0].dtype
+    if any(a.dtype != dtype for a in arrays):
+        raise ValueError("host flatten requires a single dtype")
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    total = sum(a.size for a in arrays)
+    out = np.empty((total,), dtype)
+    if _native.lib() is not None:
+        _native.flatten_into(arrays, out)
+        return out
+    off = 0
+    for a in arrays:
+        out[off:off + a.size] = a.ravel()
+        off += a.size
+    return out
+
+
+def host_unflatten_dense_tensors(flat: np.ndarray,
+                                 like: Sequence[np.ndarray]) -> list[np.ndarray]:
+    """Host-side apex_C.unflatten: scatter one flat buffer into arrays
+    shaped like ``like`` (C++ runtime with numpy fallback)."""
+    from apex_tpu.utils import _native
+
+    flat = np.ascontiguousarray(flat)
+    need = sum(int(np.prod(t.shape)) if np.ndim(t) else 1 for t in like)
+    if flat.size < need:
+        raise ValueError(
+            f"flat buffer has {flat.size} elements; 'like' needs {need}")
+    outs = [np.empty(t.shape, flat.dtype) for t in like]
+    if _native.lib() is not None:
+        _native.unflatten_from(flat, outs)
+        return outs
+    off = 0
+    for o in outs:
+        n = o.size
+        o[...] = flat[off:off + n].reshape(o.shape)
+        off += n
+    return outs
+
+
 @dataclasses.dataclass(frozen=True)
 class PackedSpec:
     """Static description of a packed pytree: treedef + per-leaf shape/dtype/offset."""

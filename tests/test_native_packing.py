@@ -1,0 +1,75 @@
+"""Native (C++) host flatten/unflatten vs the numpy fallback."""
+
+import numpy as np
+import pytest
+
+from apex_tpu.utils import _native
+from apex_tpu.utils.packing import (
+    host_flatten_dense_tensors,
+    host_unflatten_dense_tensors,
+)
+
+
+def _arrays(rng, dtype=np.float32):
+    return [rng.standard_normal((4, 8)).astype(dtype),
+            rng.standard_normal((16,)).astype(dtype),
+            rng.standard_normal((2, 3, 5)).astype(dtype)]
+
+
+def test_native_library_builds():
+    # g++ is part of this environment's toolchain; the native path must
+    # actually build here (the numpy fallback exists for machines without)
+    assert _native.lib() is not None
+
+
+def test_host_flatten_roundtrip():
+    rng = np.random.default_rng(0)
+    arrays = _arrays(rng)
+    flat = host_flatten_dense_tensors(arrays)
+    assert flat.shape == (sum(a.size for a in arrays),)
+    np.testing.assert_array_equal(
+        flat, np.concatenate([a.ravel() for a in arrays]))
+    back = host_unflatten_dense_tensors(flat, arrays)
+    for a, b in zip(arrays, back):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, np.int32])
+def test_host_flatten_dtypes(dtype):
+    rng = np.random.default_rng(1)
+    arrays = [rng.standard_normal((8, 8)).astype(dtype),
+              rng.standard_normal((3,)).astype(dtype)]
+    flat = host_flatten_dense_tensors(arrays)
+    assert flat.dtype == dtype
+    back = host_unflatten_dense_tensors(flat, arrays)
+    np.testing.assert_array_equal(back[0], arrays[0])
+
+
+def test_native_matches_numpy_fallback(monkeypatch):
+    rng = np.random.default_rng(2)
+    arrays = _arrays(rng)
+    native = host_flatten_dense_tensors(arrays)
+    monkeypatch.setattr(_native, "lib", lambda: None)
+    fallback = host_flatten_dense_tensors(arrays)
+    np.testing.assert_array_equal(native, fallback)
+
+
+def test_short_flat_buffer_rejected():
+    # both the native and fallback paths must refuse, not read past the end
+    with pytest.raises(ValueError):
+        host_unflatten_dense_tensors(np.zeros(10, np.float32),
+                                     [np.empty((4, 8), np.float32)])
+
+
+def test_mixed_dtype_rejected():
+    with pytest.raises(ValueError):
+        host_flatten_dense_tensors([np.zeros(3, np.float32),
+                                    np.zeros(3, np.float16)])
+
+
+def test_non_contiguous_inputs():
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal((8, 8)).astype(np.float32)
+    view = base[::2, ::2]  # non-contiguous
+    flat = host_flatten_dense_tensors([view])
+    np.testing.assert_array_equal(flat, view.ravel())

@@ -28,18 +28,41 @@ class FusedAdagrad(FusedOptimizer):
         weight_decay: float = 0.0,
         adagrad_w_mode: bool = False,
         master_weights: bool = False,
+        packed: bool = False,
     ):
         super().__init__(master_weights=master_weights)
         self.lr = lr
         self.eps = eps
         self.weight_decay = weight_decay
         self.adagrad_w_mode = adagrad_w_mode
+        self.packed = packed
 
     def _init(self, params: Any) -> AdagradState:
+        if self.packed:
+            from apex_tpu.utils.packing import make_packed_spec
+
+            n = make_packed_spec(params).padded_total
+            return AdagradState(jnp.int32(0), jnp.zeros((n,), jnp.float32))
         h = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         return AdagradState(jnp.int32(0), h)
 
+    def _packed_update(self, grads: Any, params: Any, state: AdagradState):
+        """One multi-tensor Pallas sweep (ops/packed_update.py)."""
+        from apex_tpu.ops.packed_update import packed_adagrad_update
+        from apex_tpu.utils.packing import (make_packed_spec, pack_pytree,
+                                            unpack_pytree)
+
+        spec = make_packed_spec(params)
+        new_p, new_h = packed_adagrad_update(
+            pack_pytree(grads, dtype=jnp.float32).flat,
+            pack_pytree(params).flat, state.sum_sq,
+            lr=self.lr, eps=self.eps, weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode)
+        return unpack_pytree(new_p, spec), AdagradState(state.step + 1, new_h)
+
     def _update(self, grads: Any, params: Any, state: AdagradState):
+        if self.packed:
+            return self._packed_update(grads, params, state)
         lr = jnp.float32(self.lr)
         wd = jnp.float32(self.weight_decay)
 

@@ -35,10 +35,12 @@ class FusedNovoGrad(FusedOptimizer):
         norm_type: int = 2,
         init_zero: bool = False,
         master_weights: bool = False,
+        packed: bool = False,
     ):
         if norm_type != 2:
             raise RuntimeError("FusedNovoGrad only supports the L2 norm.")
         super().__init__(master_weights=master_weights)
+        self.packed = packed
         self.lr = lr
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
@@ -49,11 +51,46 @@ class FusedNovoGrad(FusedOptimizer):
         self.init_zero = init_zero
 
     def _init(self, params: Any) -> NovoGradState:
+        if self.packed:
+            from apex_tpu.utils.packing import make_packed_spec
+
+            spec = make_packed_spec(params)
+            return NovoGradState(
+                jnp.int32(0),
+                jnp.zeros((spec.padded_total,), jnp.float32),
+                jnp.zeros((spec.num_leaves + 1,), jnp.float32))
         m = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         v = jax.tree.map(lambda p: jnp.zeros((), jnp.float32), params)
         return NovoGradState(jnp.int32(0), m, v)
 
+    def _packed_update(self, grads: Any, params: Any, state: NovoGradState):
+        """One flat multi-tensor sweep (ops/packed_update.py)."""
+        from apex_tpu.ops.packed_update import (packed_novograd_update,
+                                                segment_ids_for_spec)
+        from apex_tpu.utils.packing import (make_packed_spec, pack_pytree,
+                                            unpack_pytree)
+
+        step = state.step + 1
+        if self.bias_correction:
+            bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)
+        else:
+            bc1 = bc2 = jnp.float32(1.0)
+        spec = make_packed_spec(params)
+        new_p, new_m, new_v = packed_novograd_update(
+            pack_pytree(grads, dtype=jnp.float32).flat,
+            pack_pytree(params).flat, state.exp_avg, state.exp_avg_sq,
+            segment_ids_for_spec(spec), num_leaves=spec.num_leaves,
+            lr=self.lr, beta1=self.beta1, beta2=self.beta2,
+            beta3=(1.0 - self.beta1 if self.grad_averaging else 1.0),
+            eps=self.eps, weight_decay=self.weight_decay,
+            bias_correction1=bc1, bias_correction2=bc2,
+            is_first_step=(step == 1), init_zero=self.init_zero,
+            reg_inside_moment=self.reg_inside_moment)
+        return unpack_pytree(new_p, spec), NovoGradState(step, new_m, new_v)
+
     def _update(self, grads: Any, params: Any, state: NovoGradState):
+        if self.packed:
+            return self._packed_update(grads, params, state)
         step = state.step + 1
         if self.bias_correction:
             bc1, bc2 = bias_corrections(step, self.beta1, self.beta2)

@@ -77,6 +77,28 @@ def test_packed_lamb_found_inf_and_jit():
                zip(jax.tree.leaves(new_p), jax.tree.leaves(params)))
 
 
+@pytest.mark.parametrize("cls_name", ["FusedAdagrad", "FusedNovoGrad"])
+def test_packed_flag_on_optimizer_classes(cls_name):
+    """FusedAdagrad/FusedNovoGrad(packed=True) match their per-leaf step."""
+    import apex_tpu.optimizers as opts
+
+    cls = getattr(opts, cls_name)
+    rng = np.random.default_rng(10)
+    params = make_params(rng)
+    grads = make_grads(rng, params)
+    ref = cls(lr=1e-2, weight_decay=0.01)
+    pk = cls(lr=1e-2, weight_decay=0.01, packed=True)
+    ref_p, ref_s = params, ref.init(params)
+    pk_p, pk_s = params, pk.init(params)
+    for _ in range(3):
+        ref_p, ref_s = ref.step(grads, ref_p, ref_s)
+        pk_p, pk_s = pk.step(grads, pk_p, pk_s)
+    assert_trees_close(pk_p, ref_p, rtol=1e-5, atol=1e-6)
+    inner = pk_s[0]  # AdagradState.sum_sq / NovoGradState.exp_avg
+    flat_field = inner.sum_sq if cls_name == "FusedAdagrad" else inner.exp_avg
+    assert flat_field.ndim == 1  # state lives flat
+
+
 def test_packed_novograd_matches_per_leaf():
     rng = np.random.default_rng(2)
     params = make_params(rng)

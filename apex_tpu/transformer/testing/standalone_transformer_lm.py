@@ -97,6 +97,9 @@ class ParallelAttention(nn.Module):
     apply_rope: bool = False
     use_flash_attention: bool = True
     sequence_parallel_enabled: bool = False
+    # long-context: shard the sequence over this mesh axis and run ring
+    # attention (transformer.context_parallel) instead of local attention
+    context_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -119,7 +122,12 @@ class ParallelAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)  # [s, b, np, hd]
 
         if self.apply_rope:
-            freqs = _rope_freqs(s, hd, qkv.dtype)
+            # under context parallelism x holds a sequence SHARD; rotary
+            # positions must be the global ones for this rank's slice
+            offset = 0
+            if self.context_parallel_axis is not None:
+                offset = jax.lax.axis_index(self.context_parallel_axis) * s
+            freqs = _rope_freqs(s, hd, offset=offset)
             q = fused_apply_rotary_pos_emb(q, freqs)
             k = fused_apply_rotary_pos_emb(k, freqs)
 
@@ -130,14 +138,31 @@ class ParallelAttention(nn.Module):
         scale = 1.0 / float(hd) ** 0.5
 
         causal = self.attn_mask_type == AttnMaskType.causal
+        if self.context_parallel_axis is not None:
+            if attention_mask is not None or segment_ids is not None:
+                raise NotImplementedError(
+                    "context parallelism composes with causal masking only; "
+                    "express padding by trimming the global sequence")
+            if not deterministic and self.attention_dropout > 0.0:
+                raise NotImplementedError(
+                    "attention dropout under context parallelism would need "
+                    "a ring-consistent RNG; disable it for cp training")
+            from apex_tpu.transformer.context_parallel import ring_attention
+
+            ctx = ring_attention(qt, kt, vt,
+                                 axis_name=self.context_parallel_axis,
+                                 causal=causal, scale=scale)
         # segment ids express padding/varlen without a 4-D mask tensor; when
         # a caller supplies both (BERT), the flash path uses the segments and
         # the materialized fallback uses the mask — same kept-token outputs.
-        use_flash = (self.use_flash_attention
+        use_flash = (self.context_parallel_axis is None
+                     and self.use_flash_attention
                      and (segment_ids is not None
                           or (causal and attention_mask is None))
                      and (deterministic or self.attention_dropout == 0.0))
-        if use_flash:
+        if self.context_parallel_axis is not None:
+            pass  # ctx computed by the ring above
+        elif use_flash:
             ctx = flash_attention(qt, kt, vt, causal=causal,
                                   segment_ids=segment_ids, scale=scale)
         else:
@@ -168,9 +193,9 @@ class ParallelAttention(nn.Module):
         return out
 
 
-def _rope_freqs(s: int, dim: int, dtype) -> jax.Array:
+def _rope_freqs(s: int, dim: int, offset=0) -> jax.Array:
     inv = 1.0 / (10000.0 ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
-    t = jnp.arange(s, dtype=jnp.float32)
+    t = jnp.arange(s, dtype=jnp.float32) + offset
     f = jnp.outer(t, inv)  # [s, dim/2]
     return jnp.concatenate([f, f], axis=-1)[:, None, None, :]  # [s,1,1,dim]
 
@@ -185,6 +210,7 @@ class ParallelTransformerLayer(nn.Module):
     apply_rope: bool = False
     use_flash_attention: bool = True
     sequence_parallel_enabled: bool = False
+    context_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -200,6 +226,7 @@ class ParallelTransformerLayer(nn.Module):
             attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
             use_flash_attention=self.use_flash_attention,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
+            context_parallel_axis=self.context_parallel_axis,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="self_attention")(ln1, attention_mask, deterministic,
                                    segment_ids)
@@ -231,6 +258,7 @@ class ParallelTransformer(nn.Module):
     use_flash_attention: bool = True
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
+    context_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
     final_layernorm: bool = True
@@ -248,6 +276,7 @@ class ParallelTransformer(nn.Module):
                 attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
                 use_flash_attention=self.use_flash_attention,
                 sequence_parallel_enabled=self.sequence_parallel_enabled,
+                context_parallel_axis=self.context_parallel_axis,
                 params_dtype=self.params_dtype, axis_name=self.axis_name,
                 name=f"layer_{i}")
             x = layer(x, attention_mask, deterministic, segment_ids)
@@ -326,6 +355,7 @@ class TransformerLanguageModel(nn.Module):
     use_flash_attention: bool = True
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
+    context_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -344,6 +374,7 @@ class TransformerLanguageModel(nn.Module):
             use_flash_attention=self.use_flash_attention,
             activations_checkpoint=self.activations_checkpoint,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
+            context_parallel_axis=self.context_parallel_axis,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="transformer")(x, attention_mask, deterministic, segment_ids)
         return x

@@ -72,6 +72,80 @@ def test_ring_attention_grads_match_dense(cp_mesh):
                                    rtol=2e-4, atol=2e-5)
 
 
+def test_parallel_attention_with_cp_matches_local():
+    """ParallelAttention(context_parallel_axis='cp') on sequence shards
+    reproduces the unsharded block — long-context wired into the model
+    stack, rope positions offset per shard."""
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        ParallelAttention,
+    )
+
+    rng = np.random.default_rng(3)
+    s, b, h, heads = 32, 2, 16, 4
+    x = jnp.asarray(rng.standard_normal((s, b, h)), jnp.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("tp", "cp"))
+    dense_mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("tp",))
+
+    attn_local = ParallelAttention(hidden_size=h, num_attention_heads=heads,
+                                   apply_rope=True)
+    attn_cp = ParallelAttention(hidden_size=h, num_attention_heads=heads,
+                                apply_rope=True, context_parallel_axis="cp")
+
+    with dense_mesh:
+        params = jax.jit(shard_map(
+            lambda x: attn_local.init(jax.random.PRNGKey(0), x),
+            mesh=dense_mesh, in_specs=P(), out_specs=P(),
+            check_vma=False))(x)
+        want = jax.jit(shard_map(
+            lambda p, x: attn_local.apply(p, x), mesh=dense_mesh,
+            in_specs=(P(), P()), out_specs=P(), check_vma=False))(params, x)
+
+    params = jax.tree.map(np.asarray, params)  # re-place on the cp mesh
+    with mesh:
+        got = jax.jit(shard_map(
+            lambda p, x: attn_cp.apply(p, x), mesh=mesh,
+            in_specs=(P(), P("cp")), out_specs=P("cp"),
+            check_vma=False))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_full_transformer_stack_with_cp_matches_local():
+    """ParallelTransformer (2 layers + rope) over cp shards == unsharded."""
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        ParallelTransformer,
+    )
+
+    rng = np.random.default_rng(4)
+    s, b, h = 16, 2, 16
+    x = jnp.asarray(rng.standard_normal((s, b, h)), jnp.float32)
+    dense_mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("tp",))
+    cp_mesh4 = Mesh(np.array(jax.devices()[:4]).reshape(1, 4), ("tp", "cp"))
+
+    base = dict(num_layers=2, hidden_size=h, num_attention_heads=4,
+                apply_rope=True, final_layernorm=True)
+    local = ParallelTransformer(**base)
+    cp = ParallelTransformer(**base, context_parallel_axis="cp")
+
+    with dense_mesh:
+        params = jax.jit(shard_map(
+            lambda x: local.init(jax.random.PRNGKey(0), x),
+            mesh=dense_mesh, in_specs=P(), out_specs=P(),
+            check_vma=False))(x)
+        want = jax.jit(shard_map(
+            lambda p, x: local.apply(p, x), mesh=dense_mesh,
+            in_specs=(P(), P()), out_specs=P(), check_vma=False))(params, x)
+    params = jax.tree.map(np.asarray, params)
+    with cp_mesh4:
+        got = jax.jit(shard_map(
+            lambda p, x: cp.apply(p, x), mesh=cp_mesh4,
+            in_specs=(P(), P("cp")), out_specs=P("cp"),
+            check_vma=False))(params, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=5e-4, atol=5e-5)
+
+
 def test_ring_attention_bf16_and_long_sequence(cp_mesh):
     rng = np.random.default_rng(2)
     b, h, s, d = 1, 2, 1024, 32  # 128 tokens per rank

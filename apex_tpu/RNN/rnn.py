@@ -16,7 +16,7 @@ reference's ``init_hidden``/``reset_hidden``/``detach_hidden``.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -20,7 +20,6 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu.multi_tensor_apply import _nonfinite
-from apex_tpu.utils.tree_math import tree_scale
 
 
 class LossScalerState(NamedTuple):

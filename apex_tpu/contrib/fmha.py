@@ -15,9 +15,8 @@ traced), which is exactly the TPU-friendly formulation of varlen: one dense
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
-import jax
 import jax.numpy as jnp
 import flax.linen as nn
 

@@ -25,7 +25,6 @@ TPU design notes:
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

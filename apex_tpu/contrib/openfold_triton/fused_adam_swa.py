@@ -15,7 +15,7 @@ SWA buffer and ``n_averaged`` live in the optimizer state.
 from __future__ import annotations
 
 import enum
-from typing import Any, NamedTuple, Optional, Tuple
+from typing import Any, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

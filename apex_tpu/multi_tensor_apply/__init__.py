@@ -18,7 +18,7 @@ an overflow flag instead of mutating a ``noop_flag`` buffer.
 
 from __future__ import annotations
 
-from typing import Any, Sequence
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -35,7 +35,7 @@ shapes/backends and is what the parity tests diff against.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

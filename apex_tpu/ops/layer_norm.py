@@ -19,12 +19,10 @@ kernel exists to keep the activation in VMEM across the two passes.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu.ops._dispatch import kernels_enabled, lane_aligned, use_interpret
 

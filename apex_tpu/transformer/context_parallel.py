@@ -29,7 +29,6 @@ axis, e.g. ``Mesh(..., ("dp", "cp", "tp"))``.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -14,7 +14,6 @@ import ctypes
 import hashlib
 import os
 import subprocess
-import tempfile
 from pathlib import Path
 from typing import Optional
 

@@ -9,6 +9,14 @@ BASELINE.md north-star optimizer, bf16 O2 policy, donated buffers).
 ``vs_baseline`` is measured MFU / 0.45 (the BASELINE.md target), so 1.0
 means the target is met.
 
+Config note vs BASELINE.md's GPT-2 1.3B TP=8 flagship: this environment
+exposes ONE v5e chip (16 GB HBM), and 1.3B with LAMB fp32 state needs
+~18 GB — it cannot run un-sharded here.  GPT-2 medium (355M) is the
+largest config of the same family that fits with full optimizer state;
+the TP=8 sharding itself is validated functionally on the 8-device CPU
+mesh (tests/test_hlo_comm_plan.py pins the collective plan) and by the
+driver's multichip dryrun.
+
 Measurement notes (round-1 postmortem): on the tunneled TPU platform,
 ``jax.block_until_ready`` can return before the computation actually runs,
 which made round 1 report an impossible 808% MFU.  Honest timing here:

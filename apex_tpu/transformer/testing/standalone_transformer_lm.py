@@ -39,6 +39,7 @@ from apex_tpu.transformer.tensor_parallel import (
 )
 from apex_tpu.transformer.tensor_parallel.layers import _tp_size
 from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.transformer.moe import ExpertParallelMLP
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
 
 __all__ = [
@@ -200,6 +201,38 @@ def _rope_freqs(s: int, dim: int, offset=0) -> jax.Array:
     return jnp.concatenate([f, f], axis=-1)[:, None, None, :]  # [s,1,1,dim]
 
 
+class MoEParallelMLP(nn.Module):
+    """Drop-in MLP replacement routing tokens through expert-parallel
+    experts (transformer.moe.ExpertParallelMLP); the load-balancing aux
+    loss is stashed in the ``'moe_losses'`` mutable collection so callers
+    can add it to the objective (sown, not returned, to keep the layer
+    signature identical to ParallelMLP)."""
+
+    hidden_size: int
+    num_experts: int
+    ffn_hidden_size: Optional[int] = None
+    capacity_factor: float = 1.25
+    expert_parallel_axis: Optional[str] = None
+    params_dtype: Any = jnp.float32
+
+    @nn.compact
+    @jax.named_scope("moe_mlp")
+    def __call__(self, x):
+        s, b, h = x.shape
+        if h != self.hidden_size:
+            raise ValueError(f"input feature dim ({h}) != hidden_size "
+                             f"({self.hidden_size})")
+        out, aux = ExpertParallelMLP(
+            num_experts=self.num_experts, hidden_size=h,
+            ffn_hidden_size=self.ffn_hidden_size or 4 * h,
+            capacity_factor=self.capacity_factor,
+            axis_name=self.expert_parallel_axis,
+            param_dtype=self.params_dtype, name="experts")(
+            x.reshape(s * b, h))
+        self.sow("moe_losses", "load_balancing", aux)
+        return out.reshape(s, b, h)
+
+
 class ParallelTransformerLayer(nn.Module):
     """pre-LN block: LN → attn → +res → LN → MLP → +res."""
 
@@ -211,6 +244,10 @@ class ParallelTransformerLayer(nn.Module):
     use_flash_attention: bool = True
     sequence_parallel_enabled: bool = False
     context_parallel_axis: Optional[str] = None
+    # MoE: replace the dense MLP with num_experts experts (sharded over
+    # expert_parallel_axis when set)
+    moe_num_experts: Optional[int] = None
+    expert_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -237,11 +274,23 @@ class ParallelTransformerLayer(nn.Module):
             self.hidden_size,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             axis_name=self.axis_name, name="post_attention_layernorm")(x)
-        mlp = ParallelMLP(
-            self.hidden_size,
-            sequence_parallel_enabled=self.sequence_parallel_enabled,
-            params_dtype=self.params_dtype, axis_name=self.axis_name,
-            name="mlp")(ln2)
+        if self.moe_num_experts:
+            if self.sequence_parallel_enabled:
+                raise NotImplementedError(
+                    "MoE + sequence parallelism needs tp-grad-synced "
+                    "replicated experts (copy_to_tensor_model_parallel_"
+                    "region on the expert params); route tokens with "
+                    "expert_parallel_axis instead")
+            mlp = MoEParallelMLP(
+                self.hidden_size, num_experts=self.moe_num_experts,
+                expert_parallel_axis=self.expert_parallel_axis,
+                params_dtype=self.params_dtype, name="mlp")(ln2)
+        else:
+            mlp = ParallelMLP(
+                self.hidden_size,
+                sequence_parallel_enabled=self.sequence_parallel_enabled,
+                params_dtype=self.params_dtype, axis_name=self.axis_name,
+                name="mlp")(ln2)
         if self.hidden_dropout > 0.0 and not deterministic:
             mlp = nn.Dropout(self.hidden_dropout)(mlp, deterministic=False)
         return x + mlp
@@ -259,6 +308,8 @@ class ParallelTransformer(nn.Module):
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
     context_parallel_axis: Optional[str] = None
+    moe_num_experts: Optional[int] = None
+    expert_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
     final_layernorm: bool = True
@@ -277,6 +328,8 @@ class ParallelTransformer(nn.Module):
                 use_flash_attention=self.use_flash_attention,
                 sequence_parallel_enabled=self.sequence_parallel_enabled,
                 context_parallel_axis=self.context_parallel_axis,
+                moe_num_experts=self.moe_num_experts,
+                expert_parallel_axis=self.expert_parallel_axis,
                 params_dtype=self.params_dtype, axis_name=self.axis_name,
                 name=f"layer_{i}")
             x = layer(x, attention_mask, deterministic, segment_ids)
@@ -356,6 +409,8 @@ class TransformerLanguageModel(nn.Module):
     activations_checkpoint: bool = False
     sequence_parallel_enabled: bool = False
     context_parallel_axis: Optional[str] = None
+    moe_num_experts: Optional[int] = None
+    expert_parallel_axis: Optional[str] = None
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -375,6 +430,8 @@ class TransformerLanguageModel(nn.Module):
             activations_checkpoint=self.activations_checkpoint,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             context_parallel_axis=self.context_parallel_axis,
+            moe_num_experts=self.moe_num_experts,
+            expert_parallel_axis=self.expert_parallel_axis,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="transformer")(x, attention_mask, deterministic, segment_ids)
         return x

@@ -224,7 +224,7 @@ class MoEParallelMLP(nn.Module):
                              f"({self.hidden_size})")
         out, aux = ExpertParallelMLP(
             num_experts=self.num_experts, hidden_size=h,
-            ffn_hidden_size=self.ffn_hidden_size or 4 * h,
+            ffn_hidden_size=self.ffn_hidden_size,
             capacity_factor=self.capacity_factor,
             axis_name=self.expert_parallel_axis,
             param_dtype=self.params_dtype, name="experts")(
@@ -248,6 +248,7 @@ class ParallelTransformerLayer(nn.Module):
     # expert_parallel_axis when set)
     moe_num_experts: Optional[int] = None
     expert_parallel_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -284,6 +285,7 @@ class ParallelTransformerLayer(nn.Module):
             mlp = MoEParallelMLP(
                 self.hidden_size, num_experts=self.moe_num_experts,
                 expert_parallel_axis=self.expert_parallel_axis,
+                capacity_factor=self.moe_capacity_factor,
                 params_dtype=self.params_dtype, name="mlp")(ln2)
         else:
             mlp = ParallelMLP(
@@ -310,6 +312,7 @@ class ParallelTransformer(nn.Module):
     context_parallel_axis: Optional[str] = None
     moe_num_experts: Optional[int] = None
     expert_parallel_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
     final_layernorm: bool = True
@@ -330,6 +333,7 @@ class ParallelTransformer(nn.Module):
                 context_parallel_axis=self.context_parallel_axis,
                 moe_num_experts=self.moe_num_experts,
                 expert_parallel_axis=self.expert_parallel_axis,
+                moe_capacity_factor=self.moe_capacity_factor,
                 params_dtype=self.params_dtype, axis_name=self.axis_name,
                 name=f"layer_{i}")
             x = layer(x, attention_mask, deterministic, segment_ids)
@@ -411,6 +415,7 @@ class TransformerLanguageModel(nn.Module):
     context_parallel_axis: Optional[str] = None
     moe_num_experts: Optional[int] = None
     expert_parallel_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
 
@@ -432,6 +437,7 @@ class TransformerLanguageModel(nn.Module):
             context_parallel_axis=self.context_parallel_axis,
             moe_num_experts=self.moe_num_experts,
             expert_parallel_axis=self.expert_parallel_axis,
+            moe_capacity_factor=self.moe_capacity_factor,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="transformer")(x, attention_mask, deterministic, segment_ids)
         return x

@@ -89,6 +89,13 @@ def resnet18_ish(num_classes=1000, axis_name=None):
                   axis_name=axis_name)
 
 
+def resnet10_ish(num_classes=1000, axis_name=None):
+    """Two-stage CI-sized variant: same block/BN/policy code paths at a
+    fraction of the compile cost (for the convergence test tier)."""
+    return ResNet(stage_sizes=(1, 1), num_classes=num_classes,
+                  axis_name=axis_name)
+
+
 def cross_entropy(logits, labels):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
@@ -145,7 +152,8 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
     half_dtype = jnp.bfloat16 if half == "bf16" else jnp.float16
     overrides = {} if loss_scale is None else {"loss_scale": loss_scale}
     policy = get_policy(opt_level, half_dtype=half_dtype, **overrides)
-    model = (resnet50 if arch == "resnet50" else resnet18_ish)(
+    model = {"resnet50": resnet50, "resnet18": resnet18_ish,
+             "resnet10": resnet10_ish}[arch](
         num_classes, axis_name=None)  # pjit-style: stats are global already
     ddp = DistributedDataParallel(axis_name="dp", mesh=mesh)
 
@@ -248,7 +256,7 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18"])
+    ap.add_argument("--arch", default="resnet50", choices=["resnet50", "resnet18", "resnet10"])
     ap.add_argument("--opt-level", default="O2", choices=["O0", "O1", "O2", "O3"])
     ap.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
     ap.add_argument("--batch-size", type=int, default=64, help="global batch")

@@ -14,7 +14,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent
 from main import run_training  # noqa: E402
 from run_convergence import count_scaler_skips  # noqa: E402
 
-TINY = dict(arch="resnet18", steps=8, image_size=32, batch_size=8,
+TINY = dict(arch="resnet10", steps=8, image_size=32, batch_size=8,
             num_classes=10, lr=0.05, verbose=False)
 
 
@@ -23,10 +23,11 @@ def o0_trace():
     return run_training(opt_level="O0", **TINY)["losses"]
 
 
+# CI-sized slice of the cross-product: one combo per distinct code path
+# (O1 bf16 cast-lists, O2 fp16 dynamic scaler, O3 pure-half); the full
+# 12-combo sweep lives in examples/imagenet/run_convergence.py
 @pytest.mark.parametrize("opt_level,loss_scale,half", [
     ("O1", None, "bf16"),
-    ("O2", None, "bf16"),
-    ("O2", 128.0, "fp16"),
     ("O2", "dynamic", "fp16"),
     ("O3", None, "bf16"),
 ])

@@ -58,7 +58,10 @@ def test_moe_layer_in_transformer_stack():
 
     def fn(x):
         variables = stack.init(jax.random.PRNGKey(0), x)
-        out, aux_col = stack.apply(variables, x, mutable=["moe_losses"])
+        # apply with params ONLY: passing the whole init variables would
+        # hand sow the init-time moe_losses to append to (double count)
+        out, aux_col = stack.apply({"params": variables["params"]}, x,
+                                   mutable=["moe_losses"])
         aux = sum(jax.tree.leaves(aux_col["moe_losses"]))
 
         def loss(params):
@@ -67,6 +70,7 @@ def test_moe_layer_in_transformer_stack():
             return jnp.sum(y ** 2)
 
         g = jax.grad(loss)(variables["params"])
+        assert len(jax.tree.leaves(aux_col["moe_losses"])) == 2  # one/layer
         g_expert = g["layer_0"]["mlp"]["experts"]
         return out, aux, g_expert["w_in"], g_expert["router"]
 

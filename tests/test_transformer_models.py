@@ -54,7 +54,10 @@ def dp2tp4_mesh(devices):
     parallel_state.destroy_model_parallel()
 
 
-@pytest.mark.parametrize("sp", [False, True])
+# SP=True is the stronger variant (exercises every SP mapping on top of
+# TP); the SP=False collective plan is pinned by test_tensor_parallel and
+# test_hlo_comm_plan, so one full-model run suffices for suite wall time
+@pytest.mark.parametrize("sp", [True])
 def test_gpt_tp_matches_single_device(tp4_mesh, rng, sp):
     """Same full weights: tp=4 (±sequence parallel) loss/grads == world-1 run."""
     ids = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)

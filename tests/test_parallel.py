@@ -201,3 +201,49 @@ def test_clip_grad_norm(rng):
     # inf norm type
     _, inf_norm = clip_grad_norm(grads, 1.0, norm_type=float("inf"))
     assert abs(float(inf_norm) - 4.0) < 1e-6
+
+
+def test_larc_zero_norm_leaves_grad_untouched(rng):
+    """ADVICE r1: the weight-decay fold must be gated on nonzero param AND
+    grad norms (reference LARC.py applies wd only inside that branch)."""
+    from apex_tpu.optimizers import FusedSGD
+
+    params = {"w": jnp.zeros((4, 4), jnp.float32),          # zero param norm
+              "v": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32)}
+    grads = {"w": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32),
+             "v": jnp.zeros((4, 4), jnp.float32)}           # zero grad norm
+    # LARC reads weight decay from the inner optimizer (param-group parity)
+    opt = LARC(FusedSGD(lr=0.1, weight_decay=0.5), trust_coefficient=0.02)
+    plain = FusedSGD(lr=0.1)
+    new_p, _ = opt.step(grads, params, opt.init(params))
+    ref_p, _ = plain.step(grads, params, plain.init(params))
+    # zero-norm leaves: no wd fold, no trust scaling — exactly plain SGD
+    np.testing.assert_allclose(np.asarray(new_p["w"]), np.asarray(ref_p["w"]),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_p["v"]), np.asarray(ref_p["v"]),
+                               rtol=1e-6)
+
+
+def test_fp16_utils_helpers(rng):
+    from apex_tpu.fp16_utils import (
+        master_params_to_model_params,
+        model_grads_to_master_grads,
+        network_to_half,
+        prep_param_lists,
+    )
+
+    params = {"w": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32),
+              "step": jnp.int32(3)}
+    half = network_to_half(params, jnp.bfloat16)
+    assert half["w"].dtype == jnp.bfloat16
+    assert half["step"].dtype == jnp.int32  # non-float leaves untouched
+
+    model_p, master_p = prep_param_lists(half)
+    assert master_p["w"].dtype == jnp.float32
+    # masters never alias the model params (fp16util.py master copies)
+    assert master_p["w"] is not model_p["w"]
+
+    back = master_params_to_model_params(master_p, model_p)
+    assert back["w"].dtype == jnp.bfloat16
+    g32 = model_grads_to_master_grads({"w": half["w"]})
+    assert g32["w"].dtype == jnp.float32

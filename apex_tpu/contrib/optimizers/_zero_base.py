@@ -46,10 +46,24 @@ __all__ = ["ZeROState", "ZeROOptimizer"]
 
 
 def _axis_size(axis_name: Optional[str]) -> int:
-    """Static size of a mesh axis (1 when running unsharded)."""
+    """Static size of a mesh axis (1 when running unsharded).
+
+    Fails fast with a setup hint when ``axis_name`` is not bound — i.e. the
+    optimizer was called outside ``shard_map`` over a mesh that carries the
+    axis — instead of surfacing ``psum``'s unbound-axis NameError from deep
+    inside the packed-layout code at trace time.
+    """
     if axis_name is None:
         return 1
-    n = jax.lax.psum(1, axis_name)
+    try:
+        n = jax.lax.psum(1, axis_name)
+    except NameError as e:
+        raise RuntimeError(
+            f"distributed_axis {axis_name!r} is not a bound mesh axis here. "
+            "ZeRO optimizers shard state over a mesh axis: call init/step "
+            "inside shard_map over a Mesh that includes this axis (or pass "
+            "distributed_axis=None to run unsharded)."
+        ) from e
     if not isinstance(n, int):  # only when psum can't constant-fold
         raise RuntimeError(
             f"axis {axis_name!r} size is not static; call init/step inside "

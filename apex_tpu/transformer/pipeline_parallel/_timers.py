@@ -82,6 +82,12 @@ class Timers:
     def __init__(self):
         self._timers: Dict[str, _Timer] = {}
 
+    @property
+    def timers(self) -> Dict[str, "_Timer"]:
+        """Read-only view of the registry (reference surface: ported
+        Megatron/apex scripts poke ``timers.timers`` directly)."""
+        return self._timers
+
     def __call__(self, name: str) -> _Timer:
         try:
             return self._timers[name]

@@ -76,6 +76,15 @@ def forward_backward_pipelining_1f1b(
     :func:`forward_backward_pipelining_without_interleaving` numerics with
     a 1F1B memory profile.  Grads come back scaled when a scaler is given.
     """
+    if not checkpoint_stages:
+        import warnings
+
+        warnings.warn(
+            "forward_backward_pipelining_1f1b always recomputes stages from "
+            "banked inputs (the O(pp) memory bound depends on it); "
+            "checkpoint_stages=False is ignored.  Use the two-sweep "
+            "forward_backward_pipelining_without_interleaving schedule for "
+            "a no-recompute backward.", stacklevel=2)
     del checkpoint_stages, tensor_shape, dtype, disable_autocast
     del deallocate_pipeline_outputs
     if forward_only:

@@ -75,6 +75,14 @@ def host_unflatten_dense_tensors(flat: np.ndarray,
     if flat.size < need:
         raise ValueError(
             f"flat buffer has {flat.size} elements; 'like' needs {need}")
+    # apex_C.unflatten returns like-typed tensors; outputs here are allocated
+    # in flat.dtype, so a mixed-dtype 'like' would silently change dtypes
+    bad = {str(np.asarray(t).dtype) for t in like} - {str(flat.dtype)}
+    if bad:
+        raise ValueError(
+            f"'like' arrays have dtypes {sorted(bad)} != flat buffer dtype "
+            f"{flat.dtype}; unflatten preserves the flat dtype (flatten "
+            "likewise requires a single dtype)")
     outs = [np.empty(t.shape, flat.dtype) for t in like]
     if _native.lib() is not None:
         _native.unflatten_from(flat, outs)

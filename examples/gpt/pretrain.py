@@ -91,7 +91,6 @@ def main(args_list=None):
                           weight_decay=args.weight_decay)
     opt = OPTIMIZERS[args.optimizer](**opt_kwargs)
 
-    n_micro = get_num_microbatches()
     mb, s = args.micro_batch_size, args.seq_length
 
     def init_fn(batches):
@@ -117,6 +116,9 @@ def main(args_list=None):
     rng = np.random.default_rng(args.seed)
 
     def synth_batches():
+        # re-read each call: --rampup-batch-size grows the count between
+        # iterations (a changed leading dim recompiles the step, as intended)
+        n_micro = get_num_microbatches()
         ids = rng.integers(0, args.vocab_size, (n_micro, mb * dp, s))
         return {"ids": jnp.asarray(ids, jnp.int32),
                 "labels": jnp.asarray(np.roll(ids, -1, axis=-1), jnp.int32)}
@@ -138,7 +140,7 @@ def main(args_list=None):
                 params, opt_state, loss = step(params, opt_state,
                                                synth_batches())
                 loss = float(loss)
-            consumed += n_micro * mb * dp
+            consumed += get_num_microbatches() * mb * dp
             update_num_microbatches(consumed, consistency_check=False)
             if it % max(1, args.log_interval // 10) == 0 or it == iters - 1:
                 print(f"iter {it:4d}  loss {loss:.4f}  "

@@ -9,6 +9,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules import (
     build_model,
     forward_backward_no_pipelining,
     forward_backward_pipelining_1f1b,
+    forward_backward_pipelining_1f1b_interleaved,
     forward_backward_pipelining_with_interleaving,
     forward_backward_pipelining_without_interleaving,
     get_forward_backward_func,
@@ -26,6 +27,7 @@ __all__ = [
     "build_model",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_1f1b",
+    "forward_backward_pipelining_1f1b_interleaved",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
     "get_forward_backward_func",

@@ -7,6 +7,9 @@ from apex_tpu.transformer.pipeline_parallel.schedules.common import (
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_1f1b import (
     forward_backward_pipelining_1f1b,
 )
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_1f1b_interleaved import (
+    forward_backward_pipelining_1f1b_interleaved,
+)
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_no_pipelining import (
     forward_backward_no_pipelining,
 )
@@ -23,6 +26,7 @@ __all__ = [
     "build_model",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_1f1b",
+    "forward_backward_pipelining_1f1b_interleaved",
     "forward_backward_pipelining_with_interleaving",
     "forward_backward_pipelining_without_interleaving",
     "pipeline_loss",
@@ -34,13 +38,16 @@ def get_forward_backward_func(virtual_pipeline_model_parallel_size,
                               pipeline_model_parallel_size):
     """schedules/__init__.py get_forward_backward_func parity.
 
-    The non-interleaved choice is the true-1F1B schedule (O(pp)-bounded
-    activation memory, like the reference's); the autodiff two-sweep
-    remains available directly as
-    ``forward_backward_pipelining_without_interleaving``.
+    Both pp choices are the true-1F1B schedules (activation memory flat in
+    num_microbatches, like the reference's): non-interleaved pp gets
+    ``forward_backward_pipelining_1f1b`` (O(pp) in-flight bound) and
+    interleaved pp gets ``forward_backward_pipelining_1f1b_interleaved``
+    (O(vpp*pp) bound).  The autodiff two-sweep variants remain available
+    directly as ``forward_backward_pipelining_without_interleaving`` /
+    ``forward_backward_pipelining_with_interleaving``.
     """
     if pipeline_model_parallel_size > 1:
         if virtual_pipeline_model_parallel_size is not None:
-            return forward_backward_pipelining_with_interleaving
+            return forward_backward_pipelining_1f1b_interleaved
         return forward_backward_pipelining_1f1b
     return forward_backward_no_pipelining

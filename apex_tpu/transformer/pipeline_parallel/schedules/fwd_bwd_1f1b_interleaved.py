@@ -1,0 +1,222 @@
+"""Memory-bounded interleaved (virtual-stage) 1F1B pipeline schedule.
+
+Parity target: ``_forward_backward_pipelining_with_interleaving``
+(fwd_bwd_pipelining_with_interleaving.py:27-560) — the reference's
+interleaved schedule is 1F1B-shaped: each rank keeps only the *in-flight*
+microbatches alive per model chunk, so activation memory is O(vpp * pp),
+flat in ``num_microbatches``.  The autodiff-of-scan schedule in
+:mod:`.fwd_bwd_pipelining_with_interleaving` is numerics-identical but
+stacks residuals per tick (GPipe memory); this module generalizes the
+banked-input manual-vjp design of :mod:`.fwd_bwd_1f1b` to vpp chunks.
+
+TPU design — the grouped timetable as one SPMD ``lax.scan``:
+
+- Work is enumerated by a per-rank *virtual stream*: at tick ``t`` rank
+  ``r`` forwards virtual unit ``kf = t - r`` and backwards virtual unit
+  ``kb = t - (p-1-r) - (S-1)`` (``S = vpp*p`` global stages).  A unit
+  ``k`` decodes as Megatron's grouped order — group ``k // (p*vpp)``,
+  chunk ``(k // p) % vpp`` (reversed for backward), lane ``k % p`` —
+  i.e. each rank runs ``p`` microbatches of chunk 0, then ``p`` of
+  chunk 1, ...  (the reference's get_model_chunk_id timetable,
+  fwd_bwd_pipelining_with_interleaving.py:118-133).
+- With this timetable both wires are single *circular* ``ppermute``s:
+  the forward wire moves rank r -> r+1 (rank p-1's chunk-v output wraps
+  to rank 0, arriving exactly when rank 0 starts chunk v+1 of that
+  microbatch), and the backward wire is its mirror image.  No per-chunk
+  Python loop — each rank applies ONE dynamically-indexed chunk per tick,
+  so program size is flat in vpp (the per-tick vpp unroll of the autodiff
+  schedule grew linearly).
+- The only per-microbatch state is a ``2*S - 1``-slot circular bank of
+  stage *inputs* (a chunk-0 input is in flight for at most ``2*(S-1)``
+  ticks).  Backward recomputes the stage from its banked input inside an
+  in-tick ``jax.vjp`` — whole-stage activation checkpointing, exactly as
+  :mod:`.fwd_bwd_1f1b` — so residuals never cross tick boundaries and
+  peak memory is flat in ``num_microbatches`` (asserted by
+  ``tests/test_pipeline_parallel.py`` via compiled memory analysis).
+
+Numerics match :func:`forward_backward_pipelining_with_interleaving`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.transformer.parallel_state import PIPELINE_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without_interleaving import (
+    _index_mb,
+)
+
+__all__ = ["forward_backward_pipelining_1f1b_interleaved"]
+
+
+def forward_backward_pipelining_1f1b_interleaved(
+    spec: PipelineStageSpec,
+    params: Any,  # leaves stacked [vpp, ...]
+    batches: Any,
+    *,
+    num_model_chunks: int,
+    forward_only: bool = False,
+    axis_name: str = PIPELINE_PARALLEL_AXIS,
+    checkpoint_stages: bool = True,
+    grad_scaler=None,
+    scaler_state=None,
+) -> Tuple[jax.Array, Optional[Any]]:
+    """Returns (mean loss on all ranks, grads stacked [vpp, ...] or None).
+
+    Stage recompute is always on (the memory bound depends on it), as in
+    :func:`~.fwd_bwd_1f1b.forward_backward_pipelining_1f1b`.
+    """
+    vpp = num_model_chunks
+    if not checkpoint_stages:
+        import warnings
+
+        warnings.warn(
+            "forward_backward_pipelining_1f1b_interleaved always recomputes "
+            "stages from banked inputs (the O(vpp*pp) memory bound depends "
+            "on it); checkpoint_stages=False is ignored.", stacklevel=2)
+    if forward_only:
+        # the undifferentiated forward scan saves no residuals, so the
+        # existing interleaved forward is already memory-bounded
+        from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_with_interleaving import (  # noqa: E501
+            forward_backward_pipelining_with_interleaving,
+        )
+
+        return forward_backward_pipelining_with_interleaving(
+            spec, params, batches, num_model_chunks=vpp, forward_only=True,
+            axis_name=axis_name)
+
+    n_micro = jax.tree.leaves(batches)[0].shape[0]
+    p = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    S = vpp * p                      # global stages
+    group = p * vpp                  # virtual units per microbatch group
+    n_groups = -(-n_micro // p)      # ceil: last group may be partial
+    K = n_groups * group             # virtual units per rank
+    k_slots = 2 * S - 1              # max in-flight span of a banked input
+
+    scale = jnp.float32(1.0)
+    if grad_scaler is not None and scaler_state is not None:
+        scale = scaler_state.scale
+
+    def chunk(prm, v):
+        return jax.tree.map(
+            lambda l: jax.lax.dynamic_index_in_dim(l, v, 0, keepdims=False),
+            prm)
+
+    def full(prm, x_wire, mb, v):
+        """Uniform per-unit program: inject -> chunk-v stage -> head/loss.
+
+        Differentiating wrt (prm, x_wire) yields stage grads for chunk v,
+        embedding grads where (rank 0, chunk 0) injected, and head grads
+        where (rank p-1, chunk vpp-1) computed the loss — all at once, as
+        in fwd_bwd_1f1b.full.
+        """
+        inj = spec.first_fn(chunk(prm, 0), mb)
+        is_inj = jnp.logical_and(rank == 0, v == 0)
+        x = jax.tree.map(lambda a, b: jnp.where(is_inj, a, b), inj, x_wire)
+        y = spec.stage_fn(chunk(prm, v), x)
+        loss = spec.last_fn(chunk(prm, vpp - 1), y, mb)
+        return y, loss
+
+    wire0 = spec.first_fn(chunk(params, 0), _index_mb(batches, 0))
+    wire_zero = jax.tree.map(jnp.zeros_like, wire0)
+
+    def buf_like(w):
+        return jax.tree.map(
+            lambda l: jnp.zeros((k_slots,) + l.shape, l.dtype), w)
+
+    fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+    bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+
+    def decode_fwd(k):
+        g, v, lane = k // group, (k // p) % vpp, k % p
+        return g * p + lane, v           # (microbatch, chunk)
+
+    def decode_bwd(k):
+        g, lane = k // group, k % p
+        v = vpp - 1 - (k // p) % vpp     # backward visits chunks in reverse
+        kf = g * group + v * p + lane    # the unit's forward stream index
+        return g * p + lane, v, kf
+
+    carry0 = dict(
+        fwd_wire=wire_zero,
+        bwd_wire=wire_zero,
+        xbuf=buf_like(wire_zero),
+        grads=jax.tree.map(jnp.zeros_like, params),
+        loss=jnp.float32(0.0),
+    )
+
+    def tick(c, t):
+        # ---- forward unit ------------------------------------------------
+        kf = t - rank
+        mb_f, v_f = decode_fwd(jnp.maximum(kf, 0))
+        active_f = jnp.logical_and(
+            jnp.logical_and(kf >= 0, kf < K), mb_f < n_micro)
+
+        y, loss_f = full(params, c["fwd_wire"], _index_mb(batches, mb_f),
+                         v_f)
+        slot_f = jnp.where(active_f, jnp.maximum(kf, 0) % k_slots, 0)
+        xbuf = jax.tree.map(
+            lambda buf, w: jax.lax.dynamic_update_index_in_dim(
+                buf, jnp.where(
+                    active_f,
+                    w.astype(buf.dtype),
+                    jax.lax.dynamic_index_in_dim(buf, slot_f, 0, False)),
+                slot_f, 0),
+            c["xbuf"], c["fwd_wire"])
+        emits = jnp.logical_and(rank == p - 1, v_f == vpp - 1)
+        loss = c["loss"] + jnp.where(
+            jnp.logical_and(emits, active_f),
+            loss_f.astype(jnp.float32), 0.0)
+
+        # ---- backward unit: recompute chunk v_b from its banked input ---
+        kb = t - (p - 1 - rank) - (S - 1)
+        mb_b, v_b, kf_b = decode_bwd(jnp.maximum(kb, 0))
+        active_b = jnp.logical_and(
+            jnp.logical_and(kb >= 0, kb < K), mb_b < n_micro)
+
+        slot_b = jnp.where(active_b, kf_b % k_slots, 0)
+        x_saved = jax.tree.map(
+            lambda buf, w: jax.lax.dynamic_index_in_dim(
+                buf, slot_b, 0, False).astype(w.dtype),
+            xbuf, c["fwd_wire"])
+        mb_batch = _index_mb(batches, mb_b)
+        _, vjp_fn = jax.vjp(
+            lambda prm, x: full(prm, x, mb_batch, v_b), params, x_saved)
+        seeds = jnp.logical_and(rank == p - 1, v_b == vpp - 1)
+        use_wire = jnp.logical_and(active_b, jnp.logical_not(seeds))
+        dy = jax.tree.map(
+            lambda w: jnp.where(use_wire, w, jnp.zeros_like(w)),
+            c["bwd_wire"])
+        dloss = jnp.where(jnp.logical_and(seeds, active_b),
+                          scale / n_micro, 0.0).astype(loss_f.dtype)
+        dparams, dx = vjp_fn((dy, dloss))
+        grads = jax.tree.map(
+            lambda g, d: g + jnp.where(active_b, d, jnp.zeros_like(d)
+                                       ).astype(g.dtype),
+            c["grads"], dparams)
+
+        # ---- both wires move one hop around the ring --------------------
+        new_c = dict(
+            fwd_wire=jax.tree.map(
+                lambda l: jax.lax.ppermute(l, axis_name, fwd_perm), y),
+            bwd_wire=jax.tree.map(
+                lambda l: jax.lax.ppermute(l, axis_name, bwd_perm), dx),
+            xbuf=xbuf,
+            grads=grads,
+            loss=loss,
+        )
+        return new_c, None
+
+    # last backward: unit K-1 on rank 0 at tick (K-1) + (p-1) + (S-1)
+    total_ticks = K + p + S - 2
+    final, _ = jax.lax.scan(tick, carry0, jnp.arange(total_ticks))
+
+    loss = jax.lax.psum(final["loss"], axis_name) / n_micro
+    return loss, final["grads"]

@@ -11,11 +11,12 @@ TPU design: the fused core is :func:`apex_tpu.ops.flash_attention` — one
 Pallas online-softmax kernel replaces the reference's unfused QKV
 GEMM→softmax→dropout→GEMM chain *and* its fixed-sequence fmha tiles.  The
 projections stay as plain XLA matmuls (cublasLt epilogue fusion is XLA's job
-on TPU).  When attention dropout is active or the caller supplies an
-additive/time mask, the core routes through the materialized
-scaled-masked-softmax path (still fused by XLA) because those features need
-per-element probabilities; the flash path covers the
-deterministic/key-padding cases that dominate inference and bf16 training.
+on TPU).  Attention dropout runs *inside* the flash kernel
+(counter-based keep mask regenerated in the backward — the reference's
+fused softmax+dropout+Philox design, csrc/multihead_attn/ setup.py:647),
+so training with dropout never materializes [b·h, sq, sk] probabilities.
+Only an explicit additive/time mask still routes through the materialized
+scaled-masked-softmax path (those need per-element score edits).
 
 The reference's ``impl='fast'|'default'`` knob is kept: ``fast`` uses the
 flash/fused route above, ``default`` always materializes (the reference's
@@ -83,8 +84,7 @@ def _attention_core(q, k, v, *, key_padding_mask, attn_mask, mask_additive,
     key_padding_mask: [b, sk], 1/True = pad (exclude).  attn_mask: [sq, sk]
     time mask, 1/True = exclude.  Additive masks carry float values.
     """
-    use_flash = (impl == "fast" and attn_mask is None and not mask_additive
-                 and (deterministic or dropout == 0.0))
+    use_flash = (impl == "fast" and attn_mask is None and not mask_additive)
     if use_flash:
         seg = None
         if key_padding_mask is not None:
@@ -92,7 +92,15 @@ def _attention_core(q, k, v, *, key_padding_mask, attn_mask, mask_additive,
             kseg = jnp.where(key_padding_mask.astype(jnp.bool_), 0, 1)
             qseg = jnp.ones((b, q.shape[2]), jnp.int32)
             seg = (qseg.astype(jnp.int32), kseg.astype(jnp.int32))
-        return flash_attention(q, k, v, segment_ids=seg, scale=scale)
+        rate, seed = 0.0, None
+        if not deterministic and dropout > 0.0:
+            # in-kernel counter-based dropout (the reference's fused
+            # softmax+dropout); one int32 seed per apply from the rng
+            rate = dropout
+            seed = jax.random.randint(dropout_rng, (), 0, 2**31 - 1,
+                                      dtype=jnp.int32)
+        return flash_attention(q, k, v, segment_ids=seg, scale=scale,
+                               dropout_rate=rate, dropout_seed=seed)
 
     scores = jax.lax.dot_general(
         q.astype(jnp.float32) * scale, k.astype(jnp.float32),

@@ -27,6 +27,18 @@ Design (TPU-first, not a translation):
   ``delta = rowsum(do * o)``.
 - Fully-masked query rows produce zeros, matching the fused-softmax
   extensions' convention (and their gradient is exactly zero).
+- Attention dropout runs *in kernel* (parity: the reference's fused
+  softmax+dropout with Philox RNG, apex/contrib/csrc/multihead_attn/,
+  setup.py:647).  Like Philox, the RNG is *counter-based*: the keep bit
+  for score element (bh, qpos, kpos) is a stateless integer hash of
+  ``(seed, bh, qpos, kpos)`` (murmur3-finalizer avalanche), so the exact
+  mask is regenerated — never stored — in the forward and both backward
+  kernels, on every platform (plain jnp integer ops; no TPU-only PRNG
+  primitive, so interpret-mode CPU tests cover the real code path).  The
+  softmax denominator accumulates the *undropped* probabilities (dropout
+  applies to the normalized matrix), and the flash backward identity
+  ``delta = rowsum(do*o) = rowsum(p_kept * dp_kept)`` still holds, so the
+  delta precompute is unchanged.
 
 The jnp fallback implements identical semantics for unsupported
 shapes/backends and is what the parity tests diff against.
@@ -59,10 +71,13 @@ _DEFAULT_BLOCK = 1024
 
 
 def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
-                  kv_segment_ids=None, scale=None):
+                  kv_segment_ids=None, scale=None, dropout_rate=0.0,
+                  dropout_seed=None):
     """Materialized attention with flash-identical masking semantics.
 
-    q: [b, h, sq, d]; k/v: [b, h, sk, d]; segment ids: [b, s]."""
+    q: [b, h, sq, d]; k/v: [b, h, sk, d]; segment ids: [b, s].  Dropout
+    applies to the normalized probabilities (same semantics as the kernel,
+    though the keep mask comes from jax.random, not the kernel's hash)."""
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
     s = jax.lax.dot_general(
@@ -87,6 +102,11 @@ def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
     if valid is not None:
         any_valid = jnp.any(valid, axis=-1, keepdims=True)
         p = jnp.where(any_valid, p, 0.0)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(
+            jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)),
+            1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jax.lax.dot_general(
         p, v.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))))
     return out.astype(q.dtype)
@@ -95,6 +115,30 @@ def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
 # ---------------------------------------------------------------------------
 # Pallas forward
 # ---------------------------------------------------------------------------
+
+
+def _keep_mask(seed, g, i, j, bq, bk, rate):
+    """Counter-based dropout keep mask for tile (g, i, j): a murmur3-style
+    avalanche of (seed, batch-head, global q pos, global k pos).  Stateless,
+    so the forward and both backward kernels regenerate the identical mask
+    from the same coordinates (the Philox property the reference relies on).
+    """
+    qpos = (i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ).astype(jnp.uint32)
+    kpos = (j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            ).astype(jnp.uint32)
+    h = (seed.astype(jnp.uint32)
+         ^ (qpos * jnp.uint32(0x9E3779B1))
+         ^ (kpos * jnp.uint32(0x85EBCA77))
+         ^ (g.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    # P(h < T) = rate for T = rate * 2^32 (h uniform over uint32)
+    threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+    return h >= threshold
 
 
 def _block_mask(i, j, bq, bk, sq, sk, causal, has_seg, qseg, kseg):
@@ -111,9 +155,10 @@ def _block_mask(i, j, bq, bk, sq, sk, causal, has_seg, qseg, kseg):
     return valid
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, has_seg, sq, sk):
-    i, j = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
+                lse_ref, m_scr, l_scr, acc_scr, *, scale, causal, has_seg,
+                sq, sk, dropout_rate):
+    g, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nj = pl.num_programs(2)
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = k_ref.shape[1]
@@ -149,6 +194,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref,
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
         l_cur = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], g, i, j, bq, bk, dropout_rate)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         v = v_ref[0].astype(jnp.float32)
         pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -185,7 +233,8 @@ def _expand_seg(seg):
     return jnp.broadcast_to(seg[:, :, None], (*seg.shape, 128))
 
 
-def _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
+def _pallas_fwd(q, k, v, qseg, kseg, seed, causal, scale, block_q, block_k,
+                dropout_rate):
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
@@ -198,9 +247,11 @@ def _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
     sqspec, skspec = _seg_specs(b, h, bq, bk, has_seg)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                          has_seg=has_seg, sq=sq, sk=sk),
+                          has_seg=has_seg, sq=sq, sk=sk,
+                          dropout_rate=dropout_rate),
         grid=grid,
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
@@ -220,7 +271,7 @@ def _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=use_interpret(),
-    )(q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
+    )(seed, q.reshape(b * h, sq, d), k.reshape(b * h, sk, d),
       v.reshape(b * h, sk, d), qseg3, kseg3)
     return (o.reshape(b, h, sq, d), lse[:, :, 0].reshape(b, h, sq))
 
@@ -230,10 +281,10 @@ def _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                qseg_ref, kseg_ref, dq_ref, dq_scr,
-               *, scale, causal, has_seg, sq, sk):
-    i, j = pl.program_id(1), pl.program_id(2)
+               *, scale, causal, has_seg, sq, sk, dropout_rate):
+    g, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nj = pl.num_programs(2)
     bq, d = q_ref.shape[1], q_ref.shape[2]
     bk = k_ref.shape[1]
@@ -261,6 +312,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            # d(softmax) sees the dropout-masked upstream cotangent
+            keep = _keep_mask(seed_ref[0], g, i, j, bq, bk, dropout_rate)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta)
         dq_scr[...] += scale * jax.lax.dot_general(
@@ -272,9 +327,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                *, scale, causal, has_seg, sq, sk):
+                *, scale, causal, has_seg, sq, sk, dropout_rate):
+    g = pl.program_id(0)
     j, i = pl.program_id(1), pl.program_id(2)  # k block outer, q block inner
     ni = pl.num_programs(2)
     bq, d = q_ref.shape[1], q_ref.shape[2]
@@ -301,12 +357,21 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0][:, :1]
         p = jnp.exp(s - lse)
         do = do_ref[0].astype(jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], g, i, j, bq, bk, dropout_rate)
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_kept = jnp.where(keep, p * inv, 0.0)
+        else:
+            p_kept = p
+        # dv sees the dropped-and-rescaled probabilities (O = P_kept V)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_kept, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            dp = jnp.where(keep, dp * inv, 0.0)
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta)
         # q was pre-scaled, so ds·q already carries one factor of scale —
@@ -321,8 +386,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
-                block_q, block_k):
+def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, seed, causal, scale,
+                block_q, block_k, dropout_rate):
     from jax.experimental.pallas import tpu as pltpu
 
     b, h, sq, d = q.shape
@@ -345,9 +410,11 @@ def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
     sqspec, skspec = _seg_specs(b, h, bq, bk, has_seg)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          has_seg=has_seg, sq=sq, sk=sk),
+                          has_seg=has_seg, sq=sq, sk=sk,
+                          dropout_rate=dropout_rate),
         grid=(b * h, sq // bq, sk // bk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda g, i, j: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, i, j: (g, j, 0)),
@@ -360,7 +427,7 @@ def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=use_interpret(),
-    )(q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
+    )(seed, q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
 
     sqspec2, skspec2 = _seg_specs(b, h, bq, bk, has_seg)
     # swap index maps: grid is (bh, k block, q block)
@@ -369,9 +436,11 @@ def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
         skspec2 = pl.BlockSpec((1, bk, 128), lambda g, j, i: (g // h, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          has_seg=has_seg, sq=sq, sk=sk),
+                          has_seg=has_seg, sq=sq, sk=sk,
+                          dropout_rate=dropout_rate),
         grid=(b * h, sk // bk, sq // bq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda g, j, i: (g, i, 0)),
             pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
             pl.BlockSpec((1, bk, d), lambda g, j, i: (g, j, 0)),
@@ -391,7 +460,7 @@ def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=use_interpret(),
-    )(q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
+    )(seed, q3, k3, v3, do3, lse3, delta3, qseg3, kseg3)
     return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
             dv.reshape(b, h, sk, d))
 
@@ -401,22 +470,26 @@ def _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
-    o, _ = _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, qseg, kseg, seed, causal, scale, block_q, block_k,
+           dropout_rate):
+    o, _ = _pallas_fwd(q, k, v, qseg, kseg, seed, causal, scale, block_q,
+                       block_k, dropout_rate)
     return o
 
 
-def _flash_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k):
-    o, lse = _pallas_fwd(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
-    return o, (q, k, v, o, lse, qseg, kseg)
+def _flash_fwd(q, k, v, qseg, kseg, seed, causal, scale, block_q, block_k,
+               dropout_rate):
+    o, lse = _pallas_fwd(q, k, v, qseg, kseg, seed, causal, scale, block_q,
+                         block_k, dropout_rate)
+    return o, (q, k, v, o, lse, qseg, kseg, seed)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, o, lse, qseg, kseg = res
-    dq, dk, dv = _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, causal, scale,
-                             block_q, block_k)
-    return dq, dk, dv, None, None
+def _flash_bwd(causal, scale, block_q, block_k, dropout_rate, res, do):
+    q, k, v, o, lse, qseg, kseg, seed = res
+    dq, dk, dv = _pallas_bwd(q, k, v, o, lse, do, qseg, kseg, seed, causal,
+                             scale, block_q, block_k, dropout_rate)
+    return dq, dk, dv, None, None, None
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -435,10 +508,12 @@ def _kernel_ok(q, k, block_q, block_k) -> bool:
 def flash_attention(q, k, v, *, causal: bool = False,
                     segment_ids=None,
                     scale: Optional[float] = None,
+                    dropout_rate: float = 0.0,
+                    dropout_seed=None,
                     block_q: int = _DEFAULT_BLOCK,
                     block_k: int = _DEFAULT_BLOCK):
-    """Fused attention: softmax(q kᵀ · scale [+ masks]) v, never materializing
-    the score matrix.
+    """Fused attention: softmax(q kᵀ · scale [+ masks]) [dropout] v, never
+    materializing the score matrix.
 
     Args:
       q: ``[b, h, sq, d]``; k, v: ``[b, h, sk, d]``.
@@ -448,6 +523,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
         within their own segment — this is the varlen/"THD" packing story
         (reference fmha `fmha.py:33-109`) and also expresses padding masks.
       scale: logit scale; defaults to ``1/sqrt(d)``.
+      dropout_rate: attention-probability dropout (kept values rescaled by
+        ``1/(1-rate)``), regenerated counter-based in the backward — the
+        reference's fused softmax+dropout (multihead_attn csrc).  Requires
+        ``dropout_seed``.
+      dropout_seed: int (or int32 scalar array) seeding the keep mask; the
+        same seed reproduces the same mask exactly.
       block_q / block_k: kernel tile sizes (clamped to the sequence length).
 
     Returns ``[b, h, sq, d]`` in q's dtype.  Fully-masked rows give zeros.
@@ -460,7 +541,16 @@ def flash_attention(q, k, v, *, causal: bool = False,
         qseg = kseg = segment_ids
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else float(scale)
+    dropout_rate = float(dropout_rate)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
+    seed = jnp.atleast_1d(jnp.asarray(
+        0 if dropout_seed is None else dropout_seed, jnp.int32))
     if _kernel_ok(q, k, block_q, block_k):
-        return _flash(q, k, v, qseg, kseg, causal, scale, block_q, block_k)
+        return _flash(q, k, v, qseg, kseg, seed, causal, scale, block_q,
+                      block_k, dropout_rate)
     return mha_reference(q, k, v, causal=causal, q_segment_ids=qseg,
-                         kv_segment_ids=kseg, scale=scale)
+                         kv_segment_ids=kseg, scale=scale,
+                         dropout_rate=dropout_rate, dropout_seed=seed[0])

@@ -85,11 +85,12 @@ class ParallelAttention(nn.Module):
     """Multi-head self-attention with tp-sharded heads (ParallelAttention).
 
     The attention core defaults to the Pallas flash kernel
-    (:func:`apex_tpu.ops.flash_attention`): causal masks and segment-id
-    padding/varlen masks never materialize the [b, np, s, s] score matrix.
-    Explicit 4-D ``attention_mask`` tensors and active attention dropout
-    take the materialized ``FusedScaleMaskSoftmax`` path (the reference's
-    fused-softmax dispatcher semantics)."""
+    (:func:`apex_tpu.ops.flash_attention`): causal masks, segment-id
+    padding/varlen masks, and attention dropout (in-kernel counter-based
+    keep mask) never materialize the [b, np, s, s] score matrix.  Only
+    explicit 4-D ``attention_mask`` tensors take the materialized
+    ``FusedScaleMaskSoftmax`` path (the reference's fused-softmax
+    dispatcher semantics)."""
 
     hidden_size: int
     num_attention_heads: int
@@ -159,13 +160,19 @@ class ParallelAttention(nn.Module):
         use_flash = (self.context_parallel_axis is None
                      and self.use_flash_attention
                      and (segment_ids is not None
-                          or (causal and attention_mask is None))
-                     and (deterministic or self.attention_dropout == 0.0))
+                          or (causal and attention_mask is None)))
         if self.context_parallel_axis is not None:
             pass  # ctx computed by the ring above
         elif use_flash:
+            rate, seed = 0.0, None
+            if self.attention_dropout > 0.0 and not deterministic:
+                # in-kernel counter-based dropout (ops.flash_attention)
+                rate = self.attention_dropout
+                seed = jax.random.randint(self.make_rng("dropout"), (),
+                                          0, 2**31 - 1, dtype=jnp.int32)
             ctx = flash_attention(qt, kt, vt, causal=causal,
-                                  segment_ids=segment_ids, scale=scale)
+                                  segment_ids=segment_ids, scale=scale,
+                                  dropout_rate=rate, dropout_seed=seed)
         else:
             scores = jax.lax.dot_general(
                 qt, kt, (((3,), (3,)), ((0, 1), (0, 1))),
@@ -206,7 +213,13 @@ class MoEParallelMLP(nn.Module):
     experts (transformer.moe.ExpertParallelMLP); the load-balancing aux
     loss is stashed in the ``'moe_losses'`` mutable collection so callers
     can add it to the objective (sown, not returned, to keep the layer
-    signature identical to ParallelMLP)."""
+    signature identical to ParallelMLP).
+
+    **Training callers must pass** ``mutable=['moe_losses']`` to
+    ``Module.apply`` and add the sown values to the loss — flax drops a sow
+    into a non-mutable collection silently, which would train with no
+    load-balancing pressure.  A trace-time warning fires if that happens
+    with ``deterministic=False``."""
 
     hidden_size: int
     num_experts: int
@@ -217,7 +230,7 @@ class MoEParallelMLP(nn.Module):
 
     @nn.compact
     @jax.named_scope("moe_mlp")
-    def __call__(self, x):
+    def __call__(self, x, deterministic: bool = True):
         s, b, h = x.shape
         if h != self.hidden_size:
             raise ValueError(f"input feature dim ({h}) != hidden_size "
@@ -229,7 +242,16 @@ class MoEParallelMLP(nn.Module):
             axis_name=self.expert_parallel_axis,
             param_dtype=self.params_dtype, name="experts")(
             x.reshape(s * b, h))
-        self.sow("moe_losses", "load_balancing", aux)
+        stored = self.sow("moe_losses", "load_balancing", aux)
+        if not stored and not deterministic and not self.is_initializing():
+            import warnings
+
+            warnings.warn(
+                "MoE load-balancing loss was sown into 'moe_losses' but the "
+                "collection is not mutable in this apply() — the aux loss is "
+                "being DROPPED.  Training callers must pass "
+                "mutable=['moe_losses'] and add the sown values to the "
+                "objective.", stacklevel=2)
         return out.reshape(s, b, h)
 
 
@@ -286,7 +308,8 @@ class ParallelTransformerLayer(nn.Module):
                 self.hidden_size, num_experts=self.moe_num_experts,
                 expert_parallel_axis=self.expert_parallel_axis,
                 capacity_factor=self.moe_capacity_factor,
-                params_dtype=self.params_dtype, name="mlp")(ln2)
+                params_dtype=self.params_dtype, name="mlp")(
+                ln2, deterministic=deterministic)
         else:
             mlp = ParallelMLP(
                 self.hidden_size,
@@ -400,7 +423,11 @@ def parallel_lm_logits(hidden, word_embeddings, axis_name: str = TENSOR_PARALLEL
 
 
 class TransformerLanguageModel(nn.Module):
-    """Embedding + transformer (+tied LM logits helper via ``compute_logits``)."""
+    """Embedding + transformer (+tied LM logits helper via ``compute_logits``).
+
+    With ``moe_num_experts`` set, training applies must pass
+    ``mutable=['moe_losses']`` and fold the sown load-balancing losses into
+    the objective — see :class:`MoEParallelMLP`."""
 
     num_layers: int
     hidden_size: int

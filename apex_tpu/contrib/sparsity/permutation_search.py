@@ -1,26 +1,40 @@
 """Input-channel permutation search for 2:4 structured sparsity.
 
 Parity target: ``apex.contrib.sparsity.permutation_search_kernels``
-(channel_swap.py:1-200, permutation_utilities.py:44-115): permuting a
-weight matrix's input channels before applying the n:m mask can keep
-large-magnitude weights that a fixed channel order would prune; the
-reference searches with greedy channel swaps (plus CUDA-brute-forced
-exhaustive stripe checks).
+(channel_swap.py:1-200, exhaustive_search.py, permutation_utilities.py:
+44-115): permuting a weight matrix's input channels before applying the
+n:m mask can keep large-magnitude weights that a fixed channel order
+would prune.  The reference searches with three composable strategies:
+greedy channel swaps, *escape attempts* that jiggle out of converged
+local optima (channel_swap.py:130-175), and bounded *exhaustive* stripe-
+group regrouping (exhaustive_search.py: all unique assignments of a few
+stripes' columns into groups, CUDA-brute-forced).
 
 TPU scope: the *search* runs offline on the host — there is no kernel to
-feed, so this module keeps the algorithmic contract (greedy swap descent
-on retained magnitude, deterministic, identity when nothing improves) in
-vectorized numpy: each round evaluates every cross-stripe column swap
-with one batched [pairs, 16, rows, 4] top-2 reduction.  The reference's
-model-graph plumbing (permutation_lib.py, ~4.8k LoC of FX-graph analysis
-that propagates the permutation through residual skeletons) is
-PyTorch-FX-specific and out of scope; apply the returned permutation to
-your own parameter pytree with :func:`apply_permutation` / its inverse on
-the producing layer.
+feed, so this module keeps the algorithmic contracts in vectorized numpy:
+
+- greedy: each round evaluates every cross-stripe column swap with one
+  batched [pairs, 16, rows, 4] top-2 reduction;
+- escape: on convergence, force the least-bad non-improving swap and keep
+  descending, returning the best permutation seen (the reference's
+  "jiggle out" with ``escape_attempts``);
+- exhaustive(window=2): for every stripe pair, score all 35 unique
+  bipartitions of their 8 columns into two groups of 4 (the dedup rule of
+  exhaustive_search.py:9-33 — order within and between groups is
+  irrelevant, so fix column 0 in group A) in one [pairs, 35, ...] batch.
+  This strictly dominates single swaps (the 16 swap combos are a subset
+  of the 35 bipartitions).
+
+The reference's model-graph plumbing (permutation_lib.py, ~4.8k LoC of
+FX-graph analysis that propagates the permutation through residual
+skeletons) is PyTorch-FX-specific and out of scope; apply the returned
+permutation to your own parameter pytree with :func:`apply_permutation` /
+its inverse on the producing layer.
 """
 
 from __future__ import annotations
 
+import itertools
 from typing import Optional
 
 import numpy as np
@@ -46,20 +60,126 @@ def sum_after_2_to_4(matrix: np.ndarray) -> float:
     return float(_retained(m.reshape(-1, 1, 4)).sum())
 
 
+# the 35 unique bipartitions of 8 columns into two unordered groups of 4:
+# fix column 0 in group A (kills the A<->B symmetry), choose its 3 partners
+_PAIR_COMBOS = np.array(
+    [[0, *c] + [x for x in range(1, 8) if x not in c]
+     for c in itertools.combinations(range(1, 8), 3)])  # [35, 8]
+
+
+def _swap_gains(m, pair_a, pair_b, ci, cj):
+    """Gain of every cross-stripe single-column swap: [pairs, 16]."""
+    rows = m.shape[0]
+    n_stripes = m.shape[1] // 4
+    stripes = np.abs(m).reshape(rows, n_stripes, 4).transpose(1, 0, 2)
+    base = _retained(stripes)
+    sa = np.broadcast_to(stripes[pair_a, None],
+                         (len(pair_a), 16, rows, 4)).copy()
+    sb = np.broadcast_to(stripes[pair_b, None],
+                         (len(pair_b), 16, rows, 4)).copy()
+    for idx in range(16):
+        sa[:, idx, :, ci[idx]] = stripes[pair_b][:, :, cj[idx]]
+        sb[:, idx, :, cj[idx]] = stripes[pair_a][:, :, ci[idx]]
+    return (_retained(sa) + _retained(sb)
+            - base[pair_a, None] - base[pair_b, None])
+
+
+def _apply_swap(m, perm, i, j):
+    m[:, [i, j]] = m[:, [j, i]]
+    perm[[i, j]] = perm[[j, i]]
+
+
+def _greedy_with_escape(m, perm, max_rounds, escape_attempts):
+    """Greedy swap descent; on convergence, force the least-bad swap and
+    keep going (channel_swap.py:148-155's jiggle).  Tracks and restores
+    the best state seen, so escapes can only help."""
+    rows, cols = m.shape
+    n_stripes = cols // 4
+    pair_a, pair_b = np.triu_indices(n_stripes, k=1)
+    ci, cj = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+    ci, cj = ci.ravel(), cj.ravel()
+
+    best_perm = perm.copy()
+    best_score = _retained(np.abs(m).reshape(rows, n_stripes, 4)
+                           .transpose(1, 0, 2)).sum()
+    used_escapes = 0
+    for _ in range(max_rounds):
+        gains = _swap_gains(m, pair_a, pair_b, ci, cj)
+        order = np.argsort(gains.ravel())[::-1]
+        best_gain = gains.ravel()[order[0]]
+        if best_gain <= 1e-6:
+            if used_escapes >= escape_attempts:
+                break
+            # converged: jiggle out with the (used_escapes+1)-th best
+            # (non-improving) swap, deterministically
+            used_escapes += 1
+            flat = int(order[min(used_escapes, order.size - 1)])
+        else:
+            flat = int(order[0])
+        p_idx, combo = divmod(flat, 16)
+        _apply_swap(m, perm, pair_a[p_idx] * 4 + ci[combo],
+                    pair_b[p_idx] * 4 + cj[combo])
+        score = _retained(np.abs(m).reshape(rows, n_stripes, 4)
+                          .transpose(1, 0, 2)).sum()
+        if score > best_score + 1e-6:
+            best_score, best_perm = score, perm.copy()
+    return best_perm, best_score
+
+
+def _exhaustive_pairs(m, perm, max_rounds):
+    """Bounded exhaustive regrouping (exhaustive_search.py, window=2):
+    repeatedly apply the best of the 35 unique bipartitions over every
+    stripe pair until none improves."""
+    rows, cols = m.shape
+    n_stripes = cols // 4
+    if n_stripes < 2:
+        return perm
+    pair_a, pair_b = np.triu_indices(n_stripes, k=1)
+    for _ in range(max_rounds):
+        stripes = np.abs(m).reshape(rows, n_stripes, 4).transpose(1, 0, 2)
+        base = _retained(stripes)
+        cols8 = np.concatenate([stripes[pair_a], stripes[pair_b]], axis=-1)
+        # [P, rows, 35, 8] -> two [P, 35, rows, 4] group views
+        cand = cols8[:, :, _PAIR_COMBOS]          # [P, rows, 35, 8]
+        ga = cand[..., :4].transpose(0, 2, 1, 3)
+        gb = cand[..., 4:].transpose(0, 2, 1, 3)
+        gains = (_retained(ga) + _retained(gb)
+                 - base[pair_a, None] - base[pair_b, None])  # [P, 35]
+        flat = int(np.argmax(gains))
+        if gains.ravel()[flat] <= 1e-6:
+            break
+        p_idx, combo = divmod(flat, 35)
+        a, b = pair_a[p_idx], pair_b[p_idx]
+        idx8 = np.concatenate([a * 4 + np.arange(4), b * 4 + np.arange(4)])
+        new8 = idx8[_PAIR_COMBOS[combo]]
+        m[:, idx8] = m[:, new8]
+        perm[idx8] = perm[new8]
+    return perm
+
+
 def accelerated_search_for_good_permutation(
         matrix, options: Optional[dict] = None
 ) -> np.ndarray:
-    """Greedy channel-swap descent (channel_swap.py:177-200).
+    """Channel-permutation search (channel_swap.py:177-200 +
+    exhaustive_search.py strategies).
 
     Returns a permutation ``perm`` of the input channels such that
     ``matrix[:, perm]`` retains at least as much magnitude under 2:4
-    pruning as ``matrix``; identity when no swap helps.  Deterministic:
-    each round applies the single best improving cross-stripe swap.
+    pruning as ``matrix``; identity when nothing helps.  Deterministic.
+
+    options:
+      max_rounds (1000)      — per-phase iteration cap.
+      escape_attempts (10)   — forced non-improving swaps after greedy
+                               convergence (0 = plain greedy descent).
+      exhaustive_window (2)  — 0 disables the exhaustive phase; 2 runs the
+                               35-bipartition stripe-pair regrouping.
     """
     options = options or {}
     max_rounds = int(options.get("max_rounds", 1000))
-    m = np.array(np.asarray(matrix, np.float32).reshape(
-        -1, np.asarray(matrix).shape[-1]), copy=True)
+    escape_attempts = int(options.get("escape_attempts", 10))
+    window = int(options.get("exhaustive_window", 2))
+    src = np.asarray(matrix)
+    m = np.array(src.astype(np.float32).reshape(-1, src.shape[-1]), copy=True)
     rows, cols = m.shape
     if cols % 4:
         raise ValueError(f"columns ({cols}) must be a multiple of 4")
@@ -68,36 +188,15 @@ def accelerated_search_for_good_permutation(
     if n_stripes < 2:
         return perm
 
-    pair_a, pair_b = np.triu_indices(n_stripes, k=1)     # [P] stripe pairs
-    ci, cj = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
-    ci, cj = ci.ravel(), cj.ravel()                      # 16 swap combos
-
-    for _ in range(max_rounds):
-        stripes = np.abs(m).reshape(rows, n_stripes, 4).transpose(1, 0, 2)
-        base = _retained(stripes)                        # [stripes]
-
-        # candidate stripes after each swap: [P, 16, rows, 4]
-        sa = np.broadcast_to(stripes[pair_a, None],
-                             (len(pair_a), 16, rows, 4)).copy()
-        sb = np.broadcast_to(stripes[pair_b, None],
-                             (len(pair_b), 16, rows, 4)).copy()
-        # column exchange per combo: 16 iterations, each vectorized over
-        # all stripe pairs and rows
-        for idx in range(16):
-            sa[:, idx, :, ci[idx]] = stripes[pair_b][:, :, cj[idx]]
-            sb[:, idx, :, cj[idx]] = stripes[pair_a][:, :, ci[idx]]
-
-        gains = (_retained(sa) + _retained(sb)
-                 - base[pair_a, None] - base[pair_b, None])  # [P, 16]
-        flat = int(np.argmax(gains))
-        best_gain = gains.ravel()[flat]
-        if best_gain <= 1e-6:
-            break
-        p_idx, combo = divmod(flat, 16)
-        i = pair_a[p_idx] * 4 + ci[combo]
-        j = pair_b[p_idx] * 4 + cj[combo]
-        m[:, [i, j]] = m[:, [j, i]]
-        perm[[i, j]] = perm[[j, i]]
+    perm, _ = _greedy_with_escape(m, perm, max_rounds, escape_attempts)
+    # re-derive m from the best perm (escape may have left m off-best)
+    m = np.array(src.astype(np.float32).reshape(rows, cols)[:, perm])
+    if window >= 2:
+        perm = _exhaustive_pairs(m, perm, max_rounds)
+        # a regroup can open new single-swap wins; one cheap final descent
+        perm, _ = _greedy_with_escape(
+            np.array(src.astype(np.float32).reshape(rows, cols)[:, perm]),
+            perm, max_rounds, 0)
     return perm
 
 

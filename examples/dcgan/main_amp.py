@@ -1,0 +1,178 @@
+"""DCGAN with mixed precision — multiple models / optimizers / losses.
+
+Parity target: ``examples/dcgan/main_amp.py`` in the reference — the amp
+walkthrough for the GAN shape: TWO models (netG, netD), TWO optimizers,
+THREE losses each with its own loss scaler (``amp.initialize(...,
+num_losses=3)``; errD_real -> loss_id 0, errD_fake -> loss_id 1,
+errG -> loss_id 2).
+
+TPU translation: nothing is patched — ``amp.initialize`` returns policy-
+cast params and a wrapped apply per model, the three scaler states are
+threaded through the jitted step, and each loss's gradients are unscaled
+with its own scaler before the per-optimizer fused step (the reference's
+per-backward unscale-into-master-grads, done functionally).  Data is
+synthetic (the reference downloads CIFAR-10; zero-egress here), which
+exercises the identical amp flow.
+
+    python examples/dcgan/main_amp.py [--opt-level O2] [--half bf16|fp16]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedAdam
+
+
+class Generator(nn.Module):
+    """z [b, nz] -> image [b, 32, 32, nc] (NHWC; ConvTranspose stack)."""
+
+    nz: int = 64
+    ngf: int = 32
+    nc: int = 3
+
+    @nn.compact
+    def __call__(self, z):
+        x = nn.Dense(4 * 4 * self.ngf * 4)(z).reshape(-1, 4, 4, self.ngf * 4)
+        for mult in (2, 1):
+            x = nn.ConvTranspose(self.ngf * mult, (4, 4), strides=(2, 2),
+                                 padding="SAME")(x)
+            x = nn.LayerNorm()(x)          # BN-free: stable at tiny batches
+            x = nn.relu(x)
+        x = nn.ConvTranspose(self.nc, (4, 4), strides=(2, 2),
+                             padding="SAME")(x)
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """image [b, 32, 32, nc] -> logit [b]."""
+
+    ndf: int = 32
+    nc: int = 3
+
+    @nn.compact
+    def __call__(self, x):
+        for mult in (1, 2, 4):
+            x = nn.Conv(self.ndf * mult, (4, 4), strides=(2, 2),
+                        padding="SAME")(x)
+            x = nn.leaky_relu(x, 0.2)
+        return nn.Dense(1)(x.reshape(x.shape[0], -1))[:, 0]
+
+
+def bce_with_logits(logits, target):
+    """-(t log σ(x) + (1-t) log(1-σ(x))), the reference's BCELoss on D."""
+    logits = logits.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(logits, 0.0) - logits * target
+                    + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--opt-level", default="O2")
+    p.add_argument("--half", default="bf16", choices=["bf16", "fp16"])
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=16)
+    p.add_argument("--nz", type=int, default=64)
+    p.add_argument("--lr", type=float, default=2e-4)
+    args = p.parse_args()
+    half = jnp.bfloat16 if args.half == "bf16" else jnp.float16
+
+    netG, netD = Generator(nz=args.nz), Discriminator()
+    k = jax.random.PRNGKey(0)
+    kg, kd, kz = jax.random.split(k, 3)
+    g_params = netG.init(kg, jnp.zeros((1, args.nz)))
+    d_params = netD.init(kd, jnp.zeros((1, 32, 32, 3)))
+
+    # one amp config, three loss scalers (num_losses=3, reference line 214);
+    # netG shares the policy and owns loss_id 2
+    ampD = amp.initialize(netD.apply, d_params, opt_level=args.opt_level,
+                          half_dtype=half, num_losses=3)
+    ampG = amp.initialize(netG.apply, g_params, opt_level=args.opt_level,
+                          half_dtype=half, num_losses=0)
+    scaler = ampD.scaler
+    sstates = list(ampD.scaler_states)
+
+    optD = FusedAdam(lr=args.lr, betas=(0.5, 0.999),
+                     master_weights=ampD.policy.master_weights)
+    optG = FusedAdam(lr=args.lr, betas=(0.5, 0.999),
+                     master_weights=ampG.policy.master_weights)
+    d_state = optD.init(ampD.params)
+    g_state = optG.init(ampG.params)
+
+    real_label, fake_label = 1.0, 0.0
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+    def train_step(dp, gp, d_state, g_state, s0, s1, s2, real, noise):
+        # ---- D: real batch (loss_id 0) + fake batch (loss_id 1) ----
+        def errD_real(dp):
+            return bce_with_logits(ampD.apply(dp, real), real_label)
+
+        def errD_fake(dp, fake):
+            return bce_with_logits(ampD.apply(dp, fake), fake_label)
+
+        fake = ampG.apply(gp, noise)
+        fake = jax.lax.stop_gradient(fake)  # the reference's fake.detach()
+
+        lr_, gr = jax.value_and_grad(
+            lambda dp: scaler.scale_loss(errD_real(dp), s0))(dp)
+        gr, inf0 = scaler.unscale(gr, s0)
+        lf_, gf = jax.value_and_grad(
+            lambda dp: scaler.scale_loss(errD_fake(dp, fake), s1))(dp)
+        gf, inf1 = scaler.unscale(gf, s1)
+        # both backwards accumulate into D's grads (reference: two
+        # .backward() calls before optimizerD.step())
+        gD = jax.tree.map(lambda a, b: a + b, gr, gf)
+        found_D = jnp.logical_or(inf0, inf1)
+        dp, d_state = optD.step(gD, dp, d_state, found_inf=found_D)
+
+        # ---- G: fool D (loss_id 2) ----
+        def errG(gp):
+            out = ampD.apply(dp, ampG.apply(gp, noise))
+            return bce_with_logits(out, real_label)
+
+        lg_, gg = jax.value_and_grad(
+            lambda gp: scaler.scale_loss(errG(gp), s2))(gp)
+        gg, inf2 = scaler.unscale(gg, s2)
+        gp, g_state = optG.step(gg, gp, g_state, found_inf=inf2)
+        # unscale the reported losses with the scale they were scaled BY
+        # (before scaler.update moves it)
+        losses = (lr_ / s0.scale, lf_ / s1.scale, lg_ / s2.scale)
+        s0 = scaler.update(s0, inf0)
+        s1 = scaler.update(s1, inf1)
+        s2 = scaler.update(s2, inf2)
+        return dp, gp, d_state, g_state, s0, s1, s2, losses
+
+    rng = np.random.default_rng(0)
+    dp, gp = ampD.params, ampG.params
+    for step in range(args.steps):
+        # synthetic "real" images: smooth blobs distinguishable from noise
+        base = rng.standard_normal((args.batch, 8, 8, 3))
+        real = jnp.asarray(np.repeat(np.repeat(base, 4, 1), 4, 2),
+                           jnp.float32)
+        real = jnp.tanh(real)
+        noise = jnp.asarray(rng.standard_normal((args.batch, args.nz)),
+                            jnp.float32)
+        dp, gp, d_state, g_state, *sstates, losses = train_step(
+            dp, gp, d_state, g_state, *sstates, real, noise)
+        if step % 5 == 0 or step == args.steps - 1:
+            lr_, lf_, lg_ = (float(x) for x in losses)
+            print(f"[{step}/{args.steps}] Loss_D {lr_ + lf_:.4f} "
+                  f"Loss_G {lg_:.4f} scale {float(sstates[0].scale):.0f}")
+
+    for s in sstates:
+        assert np.isfinite(float(s.scale))
+    lr_, lf_, lg_ = (float(x) for x in losses)
+    assert np.isfinite(lr_ + lf_ + lg_), "non-finite GAN losses"
+    print("dcgan amp OK")
+    return lr_ + lf_, lg_
+
+
+if __name__ == "__main__":
+    main()

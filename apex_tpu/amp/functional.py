@@ -99,7 +99,13 @@ def _resolve(name: str):
 
 
 def _wrap(name: str, rule: str):
-    fn = _resolve(name)
+    return _wrap_callable(name, _resolve(name), rule)
+
+
+def _wrap_callable(name: str, fn, rule: str):
+    """The cast-rule dispatch, over any callable (listed op or
+    user-registered via register_*_function)."""
+    del name  # identification lives on fn via functools.wraps
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
@@ -137,6 +143,16 @@ def _wrap(name: str, rule: str):
     return wrapped
 
 
+def _banned(name: str, guidance: str):
+    def banned(*args, **kwargs):
+        raise RuntimeError(f"amp: {name} is banned under mixed precision.  "
+                           + guidance)
+
+    banned.__name__ = name
+    banned.__amp_rule__ = "banned"
+    return banned
+
+
 _module = sys.modules[__name__]
 for _name in _lists.HALF_FUNCS:
     setattr(_module, _name.replace(".", "_"), _wrap(_name, "half"))
@@ -146,8 +162,42 @@ for _name in _lists.PROMOTE_FUNCS:
     setattr(_module, _name.replace(".", "_"), _wrap(_name, "promote"))
 for _name in _lists.SEQUENCE_FUNCS:
     setattr(_module, _name.replace(".", "_"), _wrap(_name, "sequence"))
+for _name, _msg in _lists.BANNED_FUNCS.items():
+    setattr(_module, _name, _banned(_name, _msg))
 
-__all__ = (["active_policy", "set_active_policy", "widest_dtype"]
+
+def _register(name: str, rule: str, func=None) -> None:
+    """apex.amp.register_*_function parity: add a cast rule for ``name``
+    (resolved in the jax namespaces, or ``func`` if given) and expose the
+    wrapped op as ``amp.functional.<name>``."""
+    target = {"half": _lists.HALF_FUNCS, "float": _lists.FLOAT_FUNCS,
+              "promote": _lists.PROMOTE_FUNCS}[rule]
+    if name not in target:
+        target.append(name)
+    if func is not None:
+        wrapped = _wrap_callable(name, func, rule)
+    else:
+        wrapped = _wrap(name, rule)
+    setattr(_module, name.replace(".", "_"), wrapped)
+
+
+def register_half_function(name: str, func=None) -> None:
+    """amp.register_half_function(module, name) analog — one namespace."""
+    _register(name, "half", func)
+
+
+def register_float_function(name: str, func=None) -> None:
+    _register(name, "float", func)
+
+
+def register_promote_function(name: str, func=None) -> None:
+    _register(name, "promote", func)
+
+
+__all__ = (["active_policy", "set_active_policy", "widest_dtype",
+            "register_half_function", "register_float_function",
+            "register_promote_function"]
            + [n.replace(".", "_") for n in
               _lists.HALF_FUNCS + _lists.FLOAT_FUNCS
-              + _lists.PROMOTE_FUNCS + _lists.SEQUENCE_FUNCS])
+              + _lists.PROMOTE_FUNCS + _lists.SEQUENCE_FUNCS]
+           + list(_lists.BANNED_FUNCS))

@@ -1,8 +1,8 @@
 """The amp O1 cast-list contract as data.
 
-Parity target: ``apex.amp.lists`` (torch_overrides.py:7-112,
-functional_overrides.py, tensor_overrides.py — ~2.9k LoC of op
-classification) and the promotion engine (``apex/amp/amp.py:73-183``).
+Parity target: ``apex.amp.lists`` (torch_overrides.py:7-115,
+functional_overrides.py:1-80, tensor_overrides.py:1-63) and the promotion
+engine (``apex/amp/amp.py:73-183``).
 
 The reference expresses O1 by monkey-patching every listed torch function;
 the *behavioral contract* underneath is three rules, which is what this
@@ -17,31 +17,48 @@ module encodes for JAX ops:
   comparisons follow the same rule.
 - **SEQUENCE ops** (cat/stack): the whole sequence is cast to its widest
   member (amp.py sequence_promote).
+- **BANNED ops**: calling raises with migration guidance
+  (functional_overrides.BANNED_FUNCS).
 
-Names refer to ``jax.numpy`` / ``jax.lax`` / ``jax.nn`` functions; the
-dispatcher in :mod:`apex_tpu.amp.functional` wraps exactly these.
+``REFERENCE_MAP`` records EVERY entry of the reference's three registries:
+either the JAX op name that carries the rule here (wrapped by
+:mod:`apex_tpu.amp.functional`), a pointer to the apex_tpu module that owns
+the semantics (fp32-internal kernels need no cast wrapper), or an explicit
+N/A with the reason.  ``tensor_overrides`` dunders (``__add__`` etc.,
+tensor_overrides.py:25-48) alias the same ops as the function registries —
+JAX has one namespace, so each dunder maps to its function row.
+
+Names refer to ``jax.numpy`` / ``jax.nn`` / ``jax.lax`` / ``jnp.linalg``
+functions; the dispatcher in :mod:`apex_tpu.amp.functional` wraps exactly
+the list entries.
 """
 
 from __future__ import annotations
 
-# MXU-bound ops: run in half under O1 (torch_overrides.FP16_FUNCS:7-27)
+# MXU-bound ops: run in half under O1 (torch_overrides.FP16_FUNCS:7-27 +
+# functional_overrides.FP16_FUNCS + the _bmms batched family:73-83)
 HALF_FUNCS = [
     "matmul", "dot", "tensordot", "einsum", "vdot", "inner", "outer",
+    # the one true JAX GEMM primitive (addmm/mm/mv/bmm all lower to it)
+    "dot_general",
     # lax conv family (conv1d/2d/3d/transpose in the reference)
     "conv_general_dilated", "conv", "conv_transpose",
 ]
 
 # numerically-sensitive ops: run in fp32 under O1
-# (torch_overrides.FP32_FUNCS:29-61 + functional_overrides losses/norms)
+# (torch_overrides.FP32_FUNCS:29-61 + functional_overrides.FP32_FUNCS)
 FLOAT_FUNCS = [
     # pointwise transcendentals
     "acos", "asin", "cosh", "sinh", "tan", "exp", "expm1",
     "log", "log10", "log2", "log1p", "reciprocal", "rsqrt", "power",
+    "erf_inv",
     # reductions
     "sum", "prod", "mean", "std", "var", "cumsum", "cumprod",
     "linalg.norm", "logsumexp",
-    # softmax/loss family (functional_overrides.FP32_FUNCS)
-    "softmax", "log_softmax", "softplus",
+    # softmax/activation family (functional_overrides.FP32_FUNCS)
+    "softmax", "log_softmax", "softplus", "gelu",
+    # F.normalize analog (jax.nn.standardize)
+    "standardize",
 ]
 
 # multi-array math: promote to the widest float dtype
@@ -56,3 +73,121 @@ PROMOTE_FUNCS = [
 # sequence ops: cast all members to the widest member
 # (torch_overrides.SEQUENCE_CASTS:110-112)
 SEQUENCE_FUNCS = ["concatenate", "stack", "hstack", "vstack"]
+
+# functional_overrides.BANNED_FUNCS: name -> error guidance
+BANNED_FUNCS = {
+    "binary_cross_entropy": (
+        "amp does not work out-of-the-box with a sigmoid-then-BCE pair: "
+        "the probabilities must already be fp32.  Fuse them — compute BCE "
+        "from *logits* (see examples/dcgan/main_amp.py bce_with_logits) — "
+        "or register sigmoid as a float op via "
+        "amp.functional.register_float_function."),
+}
+
+# ---------------------------------------------------------------------------
+# Every reference registry entry, mapped (VERDICT r2 item 8).
+# value = JAX op name in the lists above, "module: ..." when an apex_tpu
+# component owns the fp32-internal semantics, or "N/A: reason".
+# ---------------------------------------------------------------------------
+REFERENCE_MAP = {
+    # --- torch_overrides.FP16_FUNCS ---
+    "conv1d": "conv_general_dilated",
+    "conv2d": "conv_general_dilated",
+    "conv3d": "conv_general_dilated",
+    "conv_transpose1d": "conv_transpose",
+    "conv_transpose2d": "conv_transpose",
+    "conv_transpose3d": "conv_transpose",
+    "conv_tbc": "N/A: time-batch-channel conv is a torch-internal layout; "
+                "conv_general_dilated expresses it via dimension_numbers",
+    "prelu": "N/A: no jax.nn.prelu; parametric slope is a user elementwise "
+             "expression XLA fuses (dtype follows its inputs)",
+    "matmul": "matmul",
+    "addmm": "matmul",          # the add rides XLA epilogue fusion
+    "addmv": "matmul",
+    "addr": "outer",
+    "mm": "matmul",
+    "mv": "matmul",
+    "bmm": "matmul",            # _bmms:73-83 (CUDA>=9.1 branch = fp16)
+    "addbmm": "matmul",
+    "baddbmm": "matmul",
+    # --- torch_overrides.FP32_FUNCS ---
+    "acos": "acos", "asin": "asin", "cosh": "cosh", "sinh": "sinh",
+    "tan": "tan", "exp": "exp", "expm1": "expm1", "log": "log",
+    "log10": "log10", "log2": "log2", "reciprocal": "reciprocal",
+    "rsqrt": "rsqrt", "erfinv": "erf_inv", "pow": "power",
+    "cumprod": "cumprod", "cumsum": "cumsum",
+    "dist": "N/A: torch.dist(a,b,p) = linalg.norm(a-b); the subtraction "
+            "promotes and the norm is FLOAT-listed",
+    "norm": "linalg.norm", "prod": "prod", "std": "std", "sum": "sum",
+    "var": "var", "mean": "mean",   # ref gates mean on torch<1.1; always on
+    "renorm": "N/A: no JAX analog; per-slice clamping composes from "
+              "FLOAT-listed linalg.norm + promote-listed divide",
+    # --- torch_overrides.CASTS ---
+    "addcdiv": "N/A: fused a+v*(t1/t2) is a user expression; the divide/"
+               "multiply/add components are PROMOTE-listed",
+    "addcmul": "N/A: as addcdiv",
+    "atan2": "arctan2",
+    "cross": "cross",
+    "bilinear": "N/A: torch.bilinear is einsum('bn,onm,bm->bo'); "
+                "einsum is HALF-listed (MXU-bound on TPU)",
+    "dot": "dot",  # HALF here, CASTS there: 1-D dot hits the MXU on TPU
+    "add": "add", "div": "divide", "mul": "multiply",
+    "eq": "equal", "ge": "greater_equal", "gt": "greater",
+    "le": "less_equal", "lt": "less", "ne": "not_equal",
+    "equal": "equal",
+    # --- torch_overrides.SEQUENCE_CASTS ---
+    "cat": "concatenate", "stack": "stack",
+    # --- functional_overrides.FP16_FUNCS (conv family mapped above) ---
+    "linear": "N/A: flax Dense lowers to dot_general (HALF-listed); O2 "
+              "casts its params wholesale",
+    # --- functional_overrides.FP32_FUNCS ---
+    "interpolate": "N/A: jax.image.resize; fp32-sensitive only for "
+                   "area/cubic — cast explicitly or register it",
+    "grid_sample": "N/A: no JAX analog (gather-based samplers are user "
+                   "code)",
+    "softplus": "softplus", "softmin": "N/A: softmax(-x); softmax is "
+                                       "FLOAT-listed",
+    "log_softmax": "log_softmax", "softmax": "softmax", "gelu": "gelu",
+    "layer_norm": "module: apex_tpu.normalization.FusedLayerNorm "
+                  "(fp32 statistics in-kernel, ops/layer_norm.py)",
+    "group_norm": "module: apex_tpu.contrib.group_norm (fp32 statistics)",
+    "local_response_norm": "N/A: obsolete (AlexNet-era); no JAX analog",
+    "normalize": "standardize",
+    "cosine_similarity": "N/A: composes from FLOAT-listed linalg.norm",
+    "poisson_nll_loss": "N/A: losses compose from FLOAT-listed exp/log/"
+                        "mean — the components carry the fp32 rule",
+    "cosine_embedding_loss": "N/A: as poisson_nll_loss",
+    "cross_entropy": "module: apex_tpu.contrib.xentropy / "
+                     "ops.fused_lm_head (fp32 logsumexp in-kernel)",
+    "hinge_embedding_loss": "N/A: as poisson_nll_loss",
+    "kl_div": "N/A: as poisson_nll_loss",
+    "l1_loss": "N/A: as poisson_nll_loss (abs/mean)",
+    "mse_loss": "N/A: as poisson_nll_loss (square/mean)",
+    "margin_ranking_loss": "N/A: as poisson_nll_loss",
+    "multilabel_margin_loss": "N/A: as poisson_nll_loss",
+    "multilabel_soft_margin_loss": "N/A: as poisson_nll_loss",
+    "multi_margin_loss": "N/A: as poisson_nll_loss",
+    "nll_loss": "N/A: as poisson_nll_loss (gather/mean)",
+    "binary_cross_entropy_with_logits": "N/A: composes from FLOAT-listed "
+                                        "softplus (see examples/dcgan)",
+    "smooth_l1_loss": "N/A: as poisson_nll_loss",
+    "soft_margin_loss": "N/A: as poisson_nll_loss",
+    "triplet_margin_loss": "N/A: as poisson_nll_loss",
+    "ctc_loss": "module: optax.ctc_loss computes fp32 log-space "
+                "internally; no cast wrapper needed",
+    # --- functional_overrides.BANNED_FUNCS ---
+    "binary_cross_entropy": "BANNED (see lists.BANNED_FUNCS)",
+    # --- tensor_overrides (dunders alias the function rows) ---
+    "__matmul__": "matmul",
+    "__pow__": "power", "__ipow__": "power", "__rpow__": "power",
+    "cpu": "N/A: jax.device_get is dtype-preserving; host transfer does "
+           "not need an fp32 cast on TPU (no half-precision host penalty)",
+    "__add__": "add", "__iadd__": "add", "__radd__": "add",
+    "__sub__": "subtract", "__isub__": "subtract", "__rsub__": "subtract",
+    "__mul__": "multiply", "__imul__": "multiply", "__rmul__": "multiply",
+    "__div__": "divide", "__idiv__": "divide", "__rdiv__": "divide",
+    "__truediv__": "true_divide", "__itruediv__": "true_divide",
+    "__rtruediv__": "true_divide",
+    "__eq__": "equal", "__ne__": "not_equal", "__ge__": "greater_equal",
+    "__gt__": "greater", "__le__": "less_equal", "__lt__": "less",
+}

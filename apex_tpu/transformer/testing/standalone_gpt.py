@@ -34,6 +34,7 @@ class GPTModel(nn.Module):
     apply_rope: bool = False
     use_flash_attention: bool = True
     activations_checkpoint: bool = False
+    activations_checkpoint_policy: Optional[str] = None
     sequence_parallel_enabled: bool = False
     params_dtype: Any = jnp.float32
     axis_name: str = TENSOR_PARALLEL_AXIS
@@ -46,6 +47,7 @@ class GPTModel(nn.Module):
             apply_rope=self.apply_rope,
             use_flash_attention=self.use_flash_attention,
             activations_checkpoint=self.activations_checkpoint,
+            activations_checkpoint_policy=self.activations_checkpoint_policy,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name)
 
@@ -53,11 +55,23 @@ class GPTModel(nn.Module):
                  deterministic: bool = True):
         """Returns per-token loss [b, s] when labels given, else logits
         [s, b, vocab/tp]."""
+        from apex_tpu.transformer.tensor_parallel.layers import _tp_size
+
         hidden = self.language_model(input_ids, position_ids,
                                      deterministic=deterministic)
         # weight tying: reuse the vocab-parallel embedding table
         word_emb = self.language_model.variables["params"]["embedding"][
             "word_embeddings"]["embedding"]
+        if (labels is not None and _tp_size(self.axis_name) == 1
+                and not self.sequence_parallel_enabled):
+            # single-shard training: fused head+CE kernel — logits never
+            # materialize (ops.fused_lm_head; ~13 ms/step on the v5e bench)
+            from apex_tpu.ops.fused_lm_head import fused_lm_head_loss
+
+            loss = fused_lm_head_loss(
+                hidden, word_emb.astype(hidden.dtype),
+                labels.T)                       # [s, b] token order
+            return loss.T                       # [b, s]
         logits = parallel_lm_logits(
             hidden, word_emb.astype(hidden.dtype), self.axis_name,
             sequence_parallel_enabled=self.sequence_parallel_enabled)

@@ -1,0 +1,88 @@
+"""Fused LM-head cross-entropy kernel parity (ops/fused_lm_head.py).
+
+The kernel must match the materialized reference (and the tp-world-1
+vocab_parallel_cross_entropy path it replaces in GPTModel) for values and
+gradients, including a non-tile-aligned vocab exercising the padded tail.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.fused_lm_head import (
+    fused_lm_head_loss,
+    lm_head_loss_reference,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_KERNELS", "interpret")
+    yield
+
+
+@pytest.mark.parametrize("vocab", [1000, 768])  # padded + aligned tails
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_matches_reference(rng, vocab, dtype):
+    T, H = 256, 128
+    h = jnp.asarray(rng.standard_normal((T, H)) * 0.5, dtype)
+    e = jnp.asarray(rng.standard_normal((vocab, H)) * 0.5, dtype)
+    lab = jnp.asarray(rng.integers(0, vocab, (T,)), jnp.int32)
+
+    out = fused_lm_head_loss(h, e, lab, block_t=128, block_v=384)
+    ref = lm_head_loss_reference(h, e, lab)
+    tol = 1e-5 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=tol, atol=tol)
+
+    def f_fused(h, e):
+        return fused_lm_head_loss(h, e, lab, block_t=128, block_v=384).mean()
+
+    def f_ref(h, e):
+        return lm_head_loss_reference(h, e, lab).mean()
+
+    gf = jax.grad(f_fused, argnums=(0, 1))(h, e)
+    gr = jax.grad(f_ref, argnums=(0, 1))(h, e)
+    gtol = 1e-4 if dtype == jnp.float32 else 4e-2
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=gtol, atol=gtol)
+
+
+def test_leading_shape_and_fallback(rng):
+    # [b, s] leading shape; T=6 not divisible by block_t -> jnp fallback
+    b, s, H, V = 2, 3, 128, 512
+    h = jnp.asarray(rng.standard_normal((b, s, H)), jnp.float32)
+    e = jnp.asarray(rng.standard_normal((V, H)), jnp.float32)
+    lab = jnp.asarray(rng.integers(0, V, (b, s)), jnp.int32)
+    out = fused_lm_head_loss(h, e, lab)
+    assert out.shape == (b, s)
+    ref = lm_head_loss_reference(h.reshape(-1, H), e, lab.reshape(-1))
+    np.testing.assert_allclose(np.asarray(out).ravel(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gpt_model_routes_through_fused_head(rng):
+    """GPTModel(tp world 1) training loss must equal the materialized
+    vocab-parallel CE it replaces, through the whole model."""
+    from apex_tpu.transformer.testing import GPTModel
+
+    vocab = 512
+    model = GPTModel(num_layers=2, hidden_size=128, num_attention_heads=4,
+                     vocab_size=vocab, max_sequence_length=64)
+    ids = jnp.asarray(rng.integers(0, vocab, (2, 64)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(0), ids)
+
+    loss = model.apply(params, ids, labels=labels)
+    assert loss.shape == (2, 64)
+    # reference: logits path through the same params
+    logits = model.apply(params, ids)  # [s, b, v]
+    logits = jnp.asarray(logits).transpose(1, 0, 2)  # [b, s, v]
+    m = logits.max(axis=-1)
+    lse = m + jnp.log(jnp.exp(logits - m[..., None]).sum(axis=-1))
+    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(lse - tgt),
+                               rtol=1e-4, atol=1e-4)

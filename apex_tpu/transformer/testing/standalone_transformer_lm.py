@@ -322,7 +322,13 @@ class ParallelTransformerLayer(nn.Module):
 
 
 class ParallelTransformer(nn.Module):
-    """Stack of layers with optional per-layer activation checkpointing."""
+    """Stack of layers with optional per-layer activation checkpointing.
+
+    ``activations_checkpoint_policy`` selects what the remat saves:
+    ``None`` (full recompute — the reference's CheckpointFunction),
+    ``'dots'`` / ``'dots_no_batch'`` (save matmul outputs, recompute
+    elementwise LN/gelu only — no extra MXU work in backward, the cheap
+    way to fit a larger batch).  Implies checkpointing when set."""
 
     num_layers: int
     hidden_size: int
@@ -331,6 +337,7 @@ class ParallelTransformer(nn.Module):
     apply_rope: bool = False
     use_flash_attention: bool = True
     activations_checkpoint: bool = False
+    activations_checkpoint_policy: Optional[str] = None
     sequence_parallel_enabled: bool = False
     context_parallel_axis: Optional[str] = None
     moe_num_experts: Optional[int] = None
@@ -345,8 +352,17 @@ class ParallelTransformer(nn.Module):
                  segment_ids=None):
         # tensor_parallel.random.CheckpointFunction semantics: recompute each
         # layer in backward when activations_checkpoint is set
-        layer_cls = (nn.remat(ParallelTransformerLayer, static_argnums=(3,))
-                     if self.activations_checkpoint else ParallelTransformerLayer)
+        if self.activations_checkpoint or self.activations_checkpoint_policy:
+            policy = {
+                None: None,
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            }[self.activations_checkpoint_policy]
+            layer_cls = nn.remat(ParallelTransformerLayer,
+                                 static_argnums=(3,), policy=policy)
+        else:
+            layer_cls = ParallelTransformerLayer
         for i in range(self.num_layers):
             layer = layer_cls(
                 self.hidden_size, self.num_attention_heads,
@@ -438,6 +454,7 @@ class TransformerLanguageModel(nn.Module):
     apply_rope: bool = False
     use_flash_attention: bool = True
     activations_checkpoint: bool = False
+    activations_checkpoint_policy: Optional[str] = None
     sequence_parallel_enabled: bool = False
     context_parallel_axis: Optional[str] = None
     moe_num_experts: Optional[int] = None
@@ -460,6 +477,7 @@ class TransformerLanguageModel(nn.Module):
             attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
             use_flash_attention=self.use_flash_attention,
             activations_checkpoint=self.activations_checkpoint,
+            activations_checkpoint_policy=self.activations_checkpoint_policy,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             context_parallel_axis=self.context_parallel_axis,
             moe_num_experts=self.moe_num_experts,

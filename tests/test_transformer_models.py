@@ -185,10 +185,15 @@ def test_gpt_rope_variant(rng):
     assert np.isfinite(np.asarray(loss)).all()
 
 
-def test_gpt_activation_checkpointing_same_loss(rng):
+@pytest.mark.parametrize("kwargs", [
+    dict(activations_checkpoint=True),
+    dict(activations_checkpoint_policy="dots"),
+    dict(activations_checkpoint_policy="dots_no_batch"),
+])
+def test_gpt_activation_checkpointing_same_loss(rng, kwargs):
     ids = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
     m1 = GPTModel(**CFG)
-    m2 = GPTModel(**CFG, activations_checkpoint=True)
+    m2 = GPTModel(**CFG, **kwargs)
     p = m1.init(jax.random.PRNGKey(0), ids)
     l1 = m1.apply(p, ids, labels=ids).mean()
     l2 = m2.apply(p, ids, labels=ids).mean()

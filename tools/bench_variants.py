@@ -1,0 +1,108 @@
+"""Bench-step variants on the real chip: one (config) per process.
+
+Usage: python tools/bench_variants.py <variant> [--mem-only]
+
+Variants:
+  base          — bench.py config (b=8, no remat)
+  b12 / b16     — larger batch, no remat
+  b16_remat     — batch 16, per-layer remat
+  b16_dots      — batch 16, checkpoint_dots policy remat
+  packed_lamb   — b=8, FusedLAMB(packed=True)
+  b12_remat     — batch 12, per-layer remat
+
+--mem-only: print compiled memory analysis and exit (no run).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.transformer.testing import GPTModel
+
+    variant = sys.argv[1] if len(sys.argv) > 1 else "base"
+    mem_only = "--mem-only" in sys.argv
+
+    num_layers, hidden, heads, vocab, seq = 24, 1024, 16, 50304, 1024
+    batch = {"b12": 12, "b16": 16, "b16_remat": 16, "b16_dots": 16,
+             "b12_remat": 12, "b12_dots": 12}.get(variant, 8)
+    remat = variant in ("b16_remat", "b12_remat")
+    policy = "dots" if variant.endswith("_dots") else None
+    packed = variant == "packed_lamb"
+    if variant.startswith("large"):  # GPT-2 large (774M)
+        num_layers, hidden, heads = 36, 1280, 20
+        batch = int(variant.split("_b")[1].split("_")[0]) if "_b" in variant else 8
+        remat = "remat" in variant
+        policy = "dots" if variant.endswith("dots") else None
+
+    model = GPTModel(num_layers=num_layers, hidden_size=hidden,
+                     num_attention_heads=heads, vocab_size=vocab,
+                     max_sequence_length=seq, params_dtype=jnp.float32,
+                     activations_checkpoint=remat,
+                     activations_checkpoint_policy=policy)
+    opt = FusedLAMB(lr=1e-3, packed=packed)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    params = model.init(jax.random.PRNGKey(0), ids)
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16)
+                          if p.dtype == jnp.float32 and p.ndim >= 2 else p,
+                          params)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, ids, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: model.apply(p, ids, labels=labels).mean())(params)
+        new_params, new_state = opt.step(grads, params, opt_state)
+        return new_params, new_state, loss
+
+    if mem_only:
+        mem = train_step.lower(params, opt_state, ids, labels
+                               ).compile().memory_analysis()
+        print(json.dumps({
+            "variant": variant, "batch": batch,
+            "temp_gb": round(mem.temp_size_in_bytes / 2**30, 2),
+            "arg_gb": round(mem.argument_size_in_bytes / 2**30, 2),
+            "total_gb": round((mem.temp_size_in_bytes
+                               + mem.argument_size_in_bytes
+                               + mem.output_size_in_bytes) / 2**30, 2)}))
+        return
+
+    def run(n):
+        nonlocal params, opt_state
+        loss = None
+        for _ in range(n):
+            params, opt_state, loss = train_step(params, opt_state, ids,
+                                                 labels)
+        return float(loss)
+
+    run(1)
+    n = 8
+    t0 = time.perf_counter(); run(n); t1 = time.perf_counter()
+    run(2 * n); t2 = time.perf_counter()
+    step = ((t2 - t1) - (t1 - t0)) / n
+    tokens = batch * seq / step
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params)
+                   if hasattr(l, "shape"))
+    fpt = 6 * n_params + 12 * num_layers * hidden * seq // 2
+    mfu = tokens * fpt / 1e12 / 197.0
+    print(json.dumps({"variant": variant, "batch": batch,
+                      "ms_per_step": round(step * 1e3, 2),
+                      "tokens_per_s": round(tokens, 1),
+                      "mfu": round(mfu, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Transformer-body component timings on the real chip at bench shapes.
+
+Where do the body's 176 ms go?  Times flash attention (fwd, fwd+bwd),
+one transformer layer (fwd, fwd+bwd), and the fused LN, at the GPT-2
+medium bench geometry (b=8, h=16 heads, s=1024, d=64, hidden=1024).
+
+Usage: python tools/layer_bench.py [attn|layer|ln ...]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def marginal(run, n=16):
+    run(1)
+    t0 = time.perf_counter(); run(n); t1 = time.perf_counter()
+    run(2 * n); t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / n
+
+
+def main():
+    from apex_tpu.ops.flash_attention import flash_attention
+    from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+    from apex_tpu.transformer.testing.standalone_transformer_lm import (
+        ParallelTransformerLayer,
+    )
+
+    b, nh, s, d, hid = 8, 16, 1024, 64, 1024
+    rng = np.random.default_rng(0)
+    which = sys.argv[1:] or ["attn", "layer", "ln"]
+    out = {}
+
+    if "attn" in which:
+        q = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
+
+        fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)
+                      .astype(jnp.float32).sum())
+        gradf = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+
+        def run_f(n):
+            o = None
+            for _ in range(n):
+                o = fwd(q, k, v)
+            return float(o)
+
+        def run_b(n):
+            o = None
+            for _ in range(n):
+                o = gradf(q, k, v)[0]
+            return float(o.ravel()[0])
+
+        out["attn_fwd_ms"] = round(marginal(run_f) * 1e3, 3)
+        out["attn_fwdbwd_ms"] = round(marginal(run_b) * 1e3, 3)
+        # per-step cost in the 24-layer model
+        out["attn_model_fwdbwd_ms"] = round(out["attn_fwdbwd_ms"] * 24, 1)
+
+    if "layer" in which:
+        layer = ParallelTransformerLayer(hid, nh, params_dtype=jnp.float32)
+        x = jnp.asarray(rng.standard_normal((s, b, hid)) * 0.1, jnp.bfloat16)
+        params = layer.init(jax.random.PRNGKey(0), x)
+        params = jax.tree.map(
+            lambda p: p.astype(jnp.bfloat16)
+            if p.dtype == jnp.float32 and p.ndim >= 2 else p, params)
+
+        fwd = jax.jit(lambda p, x: layer.apply(p, x)
+                      .astype(jnp.float32).sum())
+        gradf = jax.jit(jax.grad(
+            lambda p, x: layer.apply(p, x).astype(jnp.float32).sum(),
+            argnums=(0, 1)))
+
+        def run_f(n):
+            o = None
+            for _ in range(n):
+                o = fwd(params, x)
+            return float(o)
+
+        def run_b(n):
+            o = None
+            for _ in range(n):
+                o = gradf(params, x)[1]
+            return float(o.ravel()[0])
+
+        out["layer_fwd_ms"] = round(marginal(run_f) * 1e3, 3)
+        out["layer_fwdbwd_ms"] = round(marginal(run_b) * 1e3, 3)
+        out["layer_model_fwdbwd_ms"] = round(out["layer_fwdbwd_ms"] * 24, 1)
+
+    if "ln" in which:
+        x = jnp.asarray(rng.standard_normal((s * b, hid)), jnp.bfloat16)
+        w = jnp.ones((hid,), jnp.float32)
+        bias = jnp.zeros((hid,), jnp.float32)
+        f = jax.jit(lambda x: fused_layer_norm_affine(x, w, bias, (hid,))
+                    .astype(jnp.float32).sum())
+
+        def run(n):
+            o = None
+            for _ in range(n):
+                o = f(x)
+            return float(o)
+
+        out["ln_fwd_ms"] = round(marginal(run, 32) * 1e3, 3)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Component-level timing of the bench step on the real chip.
+
+Where do the 213 ms/step go?  One experiment per process (the chip is
+16 GB; running all variants in one process OOMs):
+
+  full    — the exact bench train step (fwd+bwd+LAMB)
+  fwdbwd  — value_and_grad only (no optimizer)
+  fwd     — loss forward only
+  opt     — LAMB step on fixed grads
+  body    — value_and_grad of the transformer body only (no CE head)
+
+Usage: python tools/profile_r3.py full [batch]
+Timing: marginal scheme as bench.py, scalar readback forcing the chain.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def marginal(fn, n=8):
+    fn(1)  # compile
+    t0 = time.perf_counter(); fn(n); t1 = time.perf_counter()
+    fn(2 * n); t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / n
+
+
+def main():
+    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.transformer.testing import GPTModel
+
+    which = sys.argv[1] if len(sys.argv) > 1 else "full"
+    num_layers, hidden, heads, vocab, seq = 24, 1024, 16, 50304, 1024
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    dtype = jnp.bfloat16
+
+    model = GPTModel(num_layers=num_layers, hidden_size=hidden,
+                     num_attention_heads=heads, vocab_size=vocab,
+                     max_sequence_length=seq, params_dtype=jnp.float32)
+    opt = FusedLAMB(lr=1e-3)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+
+    params = model.init(jax.random.PRNGKey(0), ids)
+    params = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32
+                          and p.ndim >= 2 else p, params)
+
+    if which == "full":
+        opt_state = opt.init(params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, ids, labels):
+            loss, grads = jax.value_and_grad(
+                lambda p: model.apply(p, ids, labels=labels).mean())(params)
+            new_params, new_state = opt.step(grads, params, opt_state)
+            return new_params, new_state, loss
+
+        def run(n):
+            nonlocal params, opt_state
+            loss = None
+            for _ in range(n):
+                params, opt_state, loss = train_step(params, opt_state,
+                                                     ids, labels)
+            return float(loss)
+        ms = marginal(run) * 1e3
+
+    elif which == "fwdbwd":
+        @jax.jit
+        def grad_step(params, ids, labels):
+            loss, grads = jax.value_and_grad(
+                lambda p: model.apply(p, ids, labels=labels).mean())(params)
+            # fold grads into a scalar so only 4 bytes come back
+            return loss + sum(g.astype(jnp.float32).ravel()[0]
+                              for g in jax.tree.leaves(grads))
+
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = grad_step(params, ids, labels)
+            return float(out)
+        ms = marginal(run) * 1e3
+
+    elif which == "fwd":
+        @jax.jit
+        def fwd_step(params, ids, labels):
+            return model.apply(params, ids, labels=labels).mean()
+
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = fwd_step(params, ids, labels)
+            return float(out)
+        ms = marginal(run) * 1e3
+
+    elif which == "opt":
+        opt_state = opt.init(params)
+        grads0 = jax.tree.map(
+            lambda p: jnp.full(p.shape, 1e-4, p.dtype), params)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def opt_step(params, opt_state, grads):
+            return opt.step(grads, params, opt_state)
+
+        def run(n):
+            nonlocal params, opt_state
+            for _ in range(n):
+                params, opt_state = opt_step(params, opt_state, grads0)
+            return float(jax.tree.leaves(params)[0].ravel()[0])
+        ms = marginal(run) * 1e3
+
+    elif which == "body":
+        @jax.jit
+        def body_step(params, ids):
+            def f(p):
+                hidden = model.apply(
+                    p, ids, method=lambda m, i: m.language_model(i))
+                return hidden.astype(jnp.float32).mean()
+            loss, grads = jax.value_and_grad(f)(params)
+            return loss + sum(g.astype(jnp.float32).ravel()[0]
+                              for g in jax.tree.leaves(grads))
+
+        def run(n):
+            out = None
+            for _ in range(n):
+                out = body_step(params, ids)
+            return float(out)
+        ms = marginal(run) * 1e3
+
+    else:
+        raise SystemExit(f"unknown experiment {which!r}")
+
+    print(json.dumps({"experiment": which, "batch": batch,
+                      "ms_per_step": round(ms, 2)}))
+
+
+if __name__ == "__main__":
+    main()

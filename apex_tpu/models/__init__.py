@@ -1,0 +1,15 @@
+"""apex_tpu.models — flagship model families built on the kernel toolbox.
+
+The reference ships its model zoo through examples and the transformer
+testing package (GPT/BERT, SURVEY.md §2.3); BASELINE.md's target table
+additionally names the Llama-2 family (TP x PP, RMSNorm + rope + fused
+optimizers).  This package holds the production-shaped model definitions:
+
+- :mod:`apex_tpu.models.llama` — Llama-2/3-class causal LM: RMSNorm,
+  rotary embeddings, SwiGLU, grouped-query attention, tensor-parallel
+  sharding, flash attention, fused LM-head loss.
+"""
+
+from apex_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM"]

@@ -1,0 +1,257 @@
+"""Llama-family causal LM, TPU-first.
+
+Parity target: the BASELINE.md flagship row "Llama-2 7B (TP x PP, RMSNorm +
+multi-tensor Adam)" — the reference trains Llama-class models through its
+kernel toolbox (fused RMSNorm, fused rope, flash attention); this module is
+the same composition over apex_tpu's kernels:
+
+- :class:`~apex_tpu.normalization.FusedRMSNorm` (Pallas RMS kernels)
+- :func:`~apex_tpu.ops.rope.fused_apply_rotary_pos_emb` (HF/GPT-NeoX
+  rotate-half convention, configurable theta)
+- :func:`~apex_tpu.ops.flash_attention.flash_attention` with grouped-query
+  attention (kv heads broadcast to query heads)
+- SwiGLU MLP over Column/RowParallelLinear (tp-shardable, SP-aware)
+- :func:`~apex_tpu.ops.fused_lm_head.fused_lm_head_loss` for the
+  single-shard training loss; tp keeps vocab-parallel CE.
+
+Numerics are pinned against ``transformers.LlamaForCausalLM`` (torch CPU
+oracle) in ``tests/test_llama.py`` — same weights, same logits.
+
+Layout: activations are [s, b, h] (Megatron layout, SP shards dim 0);
+inputs are [b, s] token ids.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.ops.flash_attention import flash_attention
+from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_lm_logits,
+    shard_init,
+    tp_world_size,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Llama-2/3 architecture knobs (HF LlamaConfig field names)."""
+
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None   # None = MHA; < heads = GQA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_key_value_heads or self.num_attention_heads
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls(vocab_size=128256, intermediate_size=14336,
+                   num_key_value_heads=8, rope_theta=500000.0,
+                   max_position_embeddings=8192)
+
+
+def _rope_freqs(s: int, dim: int, theta: float, offset=0) -> jax.Array:
+    inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    t = jnp.arange(s, dtype=jnp.float32) + offset
+    f = jnp.outer(t, inv)
+    return jnp.concatenate([f, f], axis=-1)[:, None, None, :]  # [s,1,1,d]
+
+
+class LlamaMLP(nn.Module):
+    """SwiGLU: down( silu(gate(x)) * up(x) )."""
+
+    config: LlamaConfig
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    @nn.compact
+    @jax.named_scope("llama_mlp")
+    def __call__(self, x):
+        cfg = self.config
+        common = dict(sequence_parallel_enabled=self.sequence_parallel_enabled,
+                      params_dtype=self.params_dtype,
+                      axis_name=self.axis_name, use_bias=False)
+        gate = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size,
+                                    gather_output=False, name="gate_proj",
+                                    **common)(x)
+        up = ColumnParallelLinear(cfg.hidden_size, cfg.intermediate_size,
+                                  gather_output=False, name="up_proj",
+                                  **common)(x)
+        h = jax.nn.silu(gate) * up
+        return RowParallelLinear(cfg.intermediate_size, cfg.hidden_size,
+                                 input_is_parallel=True, name="down_proj",
+                                 **common)(h)
+
+
+class LlamaAttention(nn.Module):
+    """Grouped-query flash attention with rotary embeddings.
+
+    kv heads are broadcast to the query-head count before the kernel (the
+    GQA share pattern); with tp, both q heads and kv heads shard over the
+    axis, so ``kv_heads % tp == 0`` is required."""
+
+    config: LlamaConfig
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    @nn.compact
+    @jax.named_scope("llama_attention")
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        world = tp_world_size(self.axis_name)
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        nq = cfg.num_attention_heads // world
+        nkv = cfg.kv_heads // world
+        common = dict(sequence_parallel_enabled=self.sequence_parallel_enabled,
+                      params_dtype=self.params_dtype,
+                      axis_name=self.axis_name, use_bias=False,
+                      gather_output=False)
+        q = ColumnParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                 name="q_proj", **common)(x)
+        k = ColumnParallelLinear(cfg.hidden_size, cfg.kv_heads * hd,
+                                 name="k_proj", **common)(x)
+        v = ColumnParallelLinear(cfg.hidden_size, cfg.kv_heads * hd,
+                                 name="v_proj", **common)(x)
+        s, b = q.shape[0], q.shape[1]
+        q = q.reshape(s, b, nq, hd)
+        k = k.reshape(s, b, nkv, hd)
+        v = v.reshape(s, b, nkv, hd)
+
+        freqs = _rope_freqs(s, hd, cfg.rope_theta)
+        q = fused_apply_rotary_pos_emb(q, freqs)
+        k = fused_apply_rotary_pos_emb(k, freqs)
+
+        # GQA: each kv head serves nq/nkv query heads
+        if nkv != nq:
+            rep = nq // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        qt = q.transpose(1, 2, 0, 3)     # [b, nq, s, hd]
+        kt = k.transpose(1, 2, 0, 3)
+        vt = v.transpose(1, 2, 0, 3)
+        ctx = flash_attention(qt, kt, vt, causal=True)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, nq * hd)
+        return RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                 input_is_parallel=True,
+                                 sequence_parallel_enabled=self.sequence_parallel_enabled,
+                                 params_dtype=self.params_dtype,
+                                 axis_name=self.axis_name, use_bias=False,
+                                 name="o_proj")(ctx)
+
+
+class LlamaDecoderLayer(nn.Module):
+    config: LlamaConfig
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        cfg = self.config
+        h = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
+                         param_dtype=self.params_dtype,
+                         name="input_layernorm")(x)
+        x = x + LlamaAttention(
+            cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
+            params_dtype=self.params_dtype, axis_name=self.axis_name,
+            name="self_attn")(h, deterministic)
+        h = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
+                         param_dtype=self.params_dtype,
+                         name="post_attention_layernorm")(x)
+        return x + LlamaMLP(
+            cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
+            params_dtype=self.params_dtype, axis_name=self.axis_name,
+            name="mlp")(h)
+
+
+class LlamaForCausalLM(nn.Module):
+    """Embedding -> decoder stack -> final RMSNorm -> LM head.
+
+    ``__call__(input_ids)`` returns logits [s, b, vocab/tp];
+    ``__call__(input_ids, labels=...)`` returns per-token loss [b, s]
+    (fused head kernel on a single shard, vocab-parallel CE under tp)."""
+
+    config: LlamaConfig
+    activations_checkpoint: bool = False
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+    @nn.compact
+    def __call__(self, input_ids, labels=None, deterministic: bool = True):
+        cfg = self.config
+        x = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, params_dtype=self.params_dtype,
+            axis_name=self.axis_name, name="embed_tokens")(input_ids)
+        x = x.transpose(1, 0, 2)  # [s, b, h]
+        if self.sequence_parallel_enabled:
+            from apex_tpu.transformer.tensor_parallel import (
+                scatter_to_sequence_parallel_region,
+            )
+
+            x = scatter_to_sequence_parallel_region(x, self.axis_name)
+
+        layer_cls = (nn.remat(LlamaDecoderLayer, static_argnums=(2,))
+                     if self.activations_checkpoint else LlamaDecoderLayer)
+        for i in range(cfg.num_hidden_layers):
+            x = layer_cls(
+                cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
+                params_dtype=self.params_dtype, axis_name=self.axis_name,
+                name=f"layers_{i}")(x, deterministic)
+        x = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
+                         param_dtype=self.params_dtype, name="norm")(x)
+
+        if cfg.tie_word_embeddings:
+            head = self.variables["params"]["embed_tokens"]["embedding"]
+        else:
+            # vocab-sharded like the embedding table ([vocab/tp, h] per rank)
+            head = self.param(
+                "lm_head",
+                shard_init(nn.initializers.normal(0.02), self.axis_name),
+                (divide(cfg.vocab_size, tp_world_size(self.axis_name)),
+                 cfg.hidden_size), self.params_dtype)
+
+        if (labels is not None and tp_world_size(self.axis_name) == 1
+                and not self.sequence_parallel_enabled):
+            from apex_tpu.ops.fused_lm_head import fused_lm_head_loss
+
+            loss = fused_lm_head_loss(x, head.astype(x.dtype), labels.T)
+            return loss.T
+        logits = parallel_lm_logits(
+            x, head.astype(x.dtype), self.axis_name,
+            sequence_parallel_enabled=self.sequence_parallel_enabled)
+        if labels is None:
+            return logits
+        return vocab_parallel_cross_entropy(
+            logits.transpose(1, 0, 2), labels, axis_name=self.axis_name)

@@ -11,6 +11,9 @@ from apex_tpu.transformer.tensor_parallel.layers import (
     ColumnParallelLinear,
     RowParallelLinear,
     VocabParallelEmbedding,
+    parallel_lm_logits,
+    shard_init,
+    tp_world_size,
 )
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
@@ -39,6 +42,9 @@ __all__ = [
     "vocab_parallel_cross_entropy",
     "broadcast_data",
     "ColumnParallelLinear",
+    "parallel_lm_logits",
+    "shard_init",
+    "tp_world_size",
     "RowParallelLinear",
     "VocabParallelEmbedding",
     "copy_to_tensor_model_parallel_region",

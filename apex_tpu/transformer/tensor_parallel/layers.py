@@ -237,3 +237,32 @@ class VocabParallelEmbedding(nn.Module):
         out = jnp.take(weight, masked, axis=0)
         out = jnp.where(in_range[..., None], out, 0.0)
         return reduce_from_tensor_model_parallel_region(out, self.axis_name)
+
+
+def parallel_lm_logits(hidden, word_embeddings, axis_name: str = TENSOR_PARALLEL_AXIS,
+                       sequence_parallel_enabled: bool = False,
+                       gather_output: bool = False):
+    """Logits = H @ E^T with E vocab-sharded (the reference's
+    parallel_lm_logits): output is [s, b, vocab/tp] unless gathered."""
+    from apex_tpu.transformer.tensor_parallel.mappings import (
+        copy_to_tensor_model_parallel_region,
+        gather_from_sequence_parallel_region,
+        gather_from_tensor_model_parallel_region,
+    )
+
+    if sequence_parallel_enabled:
+        hidden = gather_from_sequence_parallel_region(hidden, axis_name, True)
+    else:
+        hidden = copy_to_tensor_model_parallel_region(hidden, axis_name)
+    logits = jax.lax.dot_general(
+        hidden, word_embeddings,
+        (((hidden.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if gather_output:
+        logits = gather_from_tensor_model_parallel_region(logits, axis_name)
+    return logits
+
+
+# public names for model composition (apex_tpu.models builds on these)
+tp_world_size = _tp_size
+shard_init = _shard_init

@@ -37,7 +37,10 @@ from apex_tpu.transformer.tensor_parallel import (
     scatter_to_sequence_parallel_region,
     vocab_parallel_cross_entropy,
 )
-from apex_tpu.transformer.tensor_parallel.layers import _tp_size
+from apex_tpu.transformer.tensor_parallel.layers import (
+    _tp_size,
+    parallel_lm_logits,
+)
 from apex_tpu.ops.flash_attention import flash_attention
 from apex_tpu.transformer.moe import ExpertParallelMLP
 from apex_tpu.ops.rope import fused_apply_rotary_pos_emb
@@ -414,28 +417,6 @@ class Embedding(nn.Module):
         if self.sequence_parallel_enabled:
             x = scatter_to_sequence_parallel_region(x, self.axis_name)
         return x
-
-
-def parallel_lm_logits(hidden, word_embeddings, axis_name: str = TENSOR_PARALLEL_AXIS,
-                       sequence_parallel_enabled: bool = False,
-                       gather_output: bool = False):
-    """Logits = H @ E^T with E vocab-sharded (the reference's
-    parallel_lm_logits): output is [s, b, vocab/tp] unless gathered."""
-    if sequence_parallel_enabled:
-        from apex_tpu.transformer.tensor_parallel.mappings import (
-            gather_from_sequence_parallel_region,
-        )
-
-        hidden = gather_from_sequence_parallel_region(hidden, axis_name, True)
-    else:
-        hidden = copy_to_tensor_model_parallel_region(hidden, axis_name)
-    logits = jax.lax.dot_general(
-        hidden, word_embeddings,
-        (((hidden.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    if gather_output:
-        logits = gather_from_tensor_model_parallel_region(logits, axis_name)
-    return logits
 
 
 class TransformerLanguageModel(nn.Module):

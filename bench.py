@@ -244,8 +244,9 @@ def tp_dryrun(tp: int) -> None:
                                   jnp.zeros((1, seq), jnp.int32)))
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(gshapes))
     n_shard = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params_s))
+    # donated params/opt_state alias their outputs — don't count them twice
     per_chip = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
-                + mem.output_size_in_bytes)
+                + mem.output_size_in_bytes - mem.alias_size_in_bytes)
     # per-chip steady state: bf16 shard of params + fp32 LAMB m/v shard
     analytic_gb = (n_params * 2 + n_params * 4 * 2) / tp / 2**30
     result = {
@@ -261,6 +262,8 @@ def tp_dryrun(tp: int) -> None:
             "arguments": round(mem.argument_size_in_bytes / 2**30, 2),
             "temp": round(mem.temp_size_in_bytes / 2**30, 2),
             "output": round(mem.output_size_in_bytes / 2**30, 2),
+            "aliased": round(mem.alias_size_in_bytes / 2**30, 2),
+            "total": round(per_chip / 2**30, 2),
             "analytic_params_plus_state": round(analytic_gb, 2),
         },
         "collective_plan": {
@@ -287,5 +290,7 @@ if __name__ == "__main__":
     a = ap.parse_args()
     if a.dryrun:
         tp_dryrun(a.tp or 8)
+    elif a.tp:
+        ap.error("--tp requires --dryrun (the single-chip bench ignores it)")
     else:
         main()

@@ -1,10 +1,10 @@
 """Transformer-body component timings on the real chip at bench shapes.
 
-Where do the body's 176 ms go?  Times flash attention (fwd, fwd+bwd),
-one transformer layer (fwd, fwd+bwd), and the fused LN, at the GPT-2
-medium bench geometry (b=8, h=16 heads, s=1024, d=64, hidden=1024).
+Small ops sit below the tunnel's per-dispatch floor (~2.5 ms), so each
+measurement runs ITERS chained iterations inside one jitted lax.scan (the
+op output feeds the next input, defeating DCE) and divides by ITERS.
 
-Usage: python tools/layer_bench.py [attn|layer|ln ...]
+Usage: python tools/layer_bench.py [attn|attn_blk|layer|ln ...]
 """
 
 from __future__ import annotations
@@ -17,12 +17,68 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+ITERS = 50
 
-def marginal(run, n=16):
-    run(1)
-    t0 = time.perf_counter(); run(n); t1 = time.perf_counter()
-    run(2 * n); t2 = time.perf_counter()
-    return ((t2 - t1) - (t1 - t0)) / n
+
+def timed(jitted, *args):
+    """One compiled call containing ITERS iterations; returns ms/iter."""
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = jitted(*args)
+    jax.block_until_ready(out)
+    t1 = time.perf_counter()
+    return (t1 - t0) / ITERS * 1e3
+
+
+def scan_fwd(op):
+    """x -> op(x) chained ITERS times (shapes must match)."""
+
+    @jax.jit
+    def run(x):
+        def body(x, _):
+            return op(x), None
+
+        y, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return y
+
+    return run
+
+
+def scan_grad(loss_fn):
+    """Chains grad evaluations of loss_fn(x): x_{i+1} = x_i + 1e-30*g_i."""
+
+    @jax.jit
+    def run(x):
+        def body(x, _):
+            g = jax.grad(loss_fn)(x)
+            return jax.tree.map(lambda a, b: a + 1e-30 * b.astype(a.dtype),
+                                x, g), None
+
+        y, _ = jax.lax.scan(body, x, None, length=ITERS)
+        return y
+
+    return run
+
+
+def scan_grad2(loss_fn):
+    """Chains grad evaluations of loss_fn(params, x) wrt BOTH arguments —
+    wgrads are ~1/3 of a training backward and must not be DCE'd."""
+
+    @jax.jit
+    def run(params, x):
+        def body(carry, _):
+            params, x = carry
+            gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, x)
+            params = jax.tree.map(
+                lambda a, b: a + 1e-30 * b.astype(a.dtype), params, gp)
+            x = x + 1e-30 * gx.astype(x.dtype)
+            return (params, x), None
+
+        out, _ = jax.lax.scan(body, (params, x), None, length=ITERS)
+        return out
+
+    return run
 
 
 def main():
@@ -37,78 +93,56 @@ def main():
     which = sys.argv[1:] or ["attn", "layer", "ln"]
     out = {}
 
-    if "attn" in which:
-        q = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
-        k = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
-        v = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1, jnp.bfloat16)
+    def qkv_of(x):
+        # cheap q/k/v from one carried tensor (keeps the scan carry small)
+        return x, jnp.roll(x, 1, axis=2), jnp.roll(x, 2, axis=2)
 
-        fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)
-                      .astype(jnp.float32).sum())
-        gradf = jax.jit(jax.grad(
-            lambda q, k, v: flash_attention(q, k, v, causal=True)
-            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    if "attn" in which or "attn_blk" in which:
+        x0 = jnp.asarray(rng.standard_normal((b, nh, s, d)) * 0.1,
+                         jnp.bfloat16)
+        blocks = ([(1024, 1024)] if "attn_blk" not in which
+                  else [(1024, 1024), (512, 1024), (512, 512), (256, 1024)])
+        for bq, bk in blocks:
+            def op(x, bq=bq, bk=bk):
+                q, k, v = qkv_of(x)
+                return flash_attention(q, k, v, causal=True,
+                                       block_q=bq, block_k=bk)
 
-        def run_f(n):
-            o = None
-            for _ in range(n):
-                o = fwd(q, k, v)
-            return float(o)
+            def loss(x, bq=bq, bk=bk):
+                return op(x, bq, bk).astype(jnp.float32).sum()
 
-        def run_b(n):
-            o = None
-            for _ in range(n):
-                o = gradf(q, k, v)[0]
-            return float(o.ravel()[0])
-
-        out["attn_fwd_ms"] = round(marginal(run_f) * 1e3, 3)
-        out["attn_fwdbwd_ms"] = round(marginal(run_b) * 1e3, 3)
-        # per-step cost in the 24-layer model
-        out["attn_model_fwdbwd_ms"] = round(out["attn_fwdbwd_ms"] * 24, 1)
+            key = f"attn_{bq}x{bk}"
+            out[key + "_fwd_ms"] = round(timed(scan_fwd(op), x0), 3)
+            out[key + "_fwdbwd_ms"] = round(timed(scan_grad(loss), x0), 3)
 
     if "layer" in which:
         layer = ParallelTransformerLayer(hid, nh, params_dtype=jnp.float32)
-        x = jnp.asarray(rng.standard_normal((s, b, hid)) * 0.1, jnp.bfloat16)
-        params = layer.init(jax.random.PRNGKey(0), x)
+        x0 = jnp.asarray(rng.standard_normal((s, b, hid)) * 0.1, jnp.bfloat16)
+        params = layer.init(jax.random.PRNGKey(0), x0)
         params = jax.tree.map(
             lambda p: p.astype(jnp.bfloat16)
             if p.dtype == jnp.float32 and p.ndim >= 2 else p, params)
 
-        fwd = jax.jit(lambda p, x: layer.apply(p, x)
-                      .astype(jnp.float32).sum())
-        gradf = jax.jit(jax.grad(
-            lambda p, x: layer.apply(p, x).astype(jnp.float32).sum(),
-            argnums=(0, 1)))
+        def op(x):
+            return layer.apply(params, x)
 
-        def run_f(n):
-            o = None
-            for _ in range(n):
-                o = fwd(params, x)
-            return float(o)
+        def loss(p, x):
+            return layer.apply(p, x).astype(jnp.float32).sum()
 
-        def run_b(n):
-            o = None
-            for _ in range(n):
-                o = gradf(params, x)[1]
-            return float(o.ravel()[0])
-
-        out["layer_fwd_ms"] = round(marginal(run_f) * 1e3, 3)
-        out["layer_fwdbwd_ms"] = round(marginal(run_b) * 1e3, 3)
+        out["layer_fwd_ms"] = round(timed(scan_fwd(op), x0), 3)
+        out["layer_fwdbwd_ms"] = round(
+            timed(scan_grad2(loss), params, x0), 3)
         out["layer_model_fwdbwd_ms"] = round(out["layer_fwdbwd_ms"] * 24, 1)
 
     if "ln" in which:
-        x = jnp.asarray(rng.standard_normal((s * b, hid)), jnp.bfloat16)
+        x0 = jnp.asarray(rng.standard_normal((s * b, hid)), jnp.bfloat16)
         w = jnp.ones((hid,), jnp.float32)
         bias = jnp.zeros((hid,), jnp.float32)
-        f = jax.jit(lambda x: fused_layer_norm_affine(x, w, bias, (hid,))
-                    .astype(jnp.float32).sum())
 
-        def run(n):
-            o = None
-            for _ in range(n):
-                o = f(x)
-            return float(o)
+        def op(x):
+            return fused_layer_norm_affine(x, w, bias, (hid,)).astype(x.dtype)
 
-        out["ln_fwd_ms"] = round(marginal(run, 32) * 1e3, 3)
+        out["ln_fwd_ms"] = round(timed(scan_fwd(op), x0), 3)
 
     print(json.dumps(out))
 

@@ -11,7 +11,6 @@ Prints one JSON line with per-shape times and the fused/unfused ratio.
 
 from __future__ import annotations
 
-import functools
 import json
 import time
 
@@ -67,7 +66,7 @@ def main():
         bias = jnp.asarray(rng.standard_normal((b, 1, h, s, s)), jnp.bfloat16)
         mask = jnp.asarray(rng.random((b, r, 1, 1, s)) > 0.1)
 
-        fused = jax.jit(functools.partial(attention_core))
+        fused = jax.jit(attention_core)
         tf = time_fn(lambda: fused(q, k, v, mask, bias))
         tu = time_fn(lambda: unfused(q, k, v, mask, bias))
         rows.append({

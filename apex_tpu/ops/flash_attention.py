@@ -60,8 +60,12 @@ _NEG_INF = -1e30
 # work to amortize grid overhead (measured 5 TF/s at 128x128 vs ~90 TF/s at
 # 1024x1024 on v5e, b8 h16 s1024 d64).  VMEM at 1024x1024: the fp32 p tile is
 # 4 MiB + q/k/v/do/acc tiles ≈ 7 MiB total — comfortably under the ~16 MiB
-# budget for d ≤ 128.  Longer sequences keep 1024-wide tiles and grid over
-# the rest (causal whole-block skip then prunes the upper triangle).
+# budget for d ≤ 128.  Longer sequences keep wide tiles and grid over the
+# rest (causal whole-block skip then prunes the upper triangle).
+# (an isolated block sweep suggested block_q=512 wins fwd+bwd, but the full
+# training step measured WORSE at 512 — 220.5 vs 213 ms/step; in-model
+# measurement is authoritative, so both defaults stay 1024)
+_DEFAULT_BLOCK_Q = 1024
 _DEFAULT_BLOCK = 1024
 
 
@@ -174,10 +178,11 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        # matmul operands stay in the input dtype: bf16 hits the MXU at
+        # native rate with fp32 accumulation; scale applies to the fp32
+        # product (an fp32 upcast of q/k forces the slow multi-pass path)
+        s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
                             qseg_ref[0] if has_seg else None,
                             kseg_ref[0] if has_seg else None)
@@ -188,17 +193,22 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref,
         m_cur = jnp.maximum(jnp.max(s, axis=-1, keepdims=True), m_prev)
         # exp(-inf - -inf) is nan; a still-empty row keeps correction 1
         corr = jnp.where(m_prev == -jnp.inf, 0.0, jnp.exp(m_prev - m_cur))
-        corr = jnp.where(m_cur == -jnp.inf, 1.0, corr)
-        p = jnp.exp(jnp.where(m_cur == -jnp.inf, 0.0, s - m_cur))
-        # rows whose every element is masked contribute nothing
-        if valid is not None:
-            p = jnp.where(valid, p, 0.0)
+        if has_seg or (causal and sq > sk):
+            # fully-masked rows (m_cur = -inf, or finite but all-_NEG_INF)
+            # exist with segment padding and with causal sq > sk (leading
+            # queries see no keys); square causal always keeps the diagonal
+            corr = jnp.where(m_cur == -jnp.inf, 1.0, corr)
+            p = jnp.exp(jnp.where(m_cur == -jnp.inf, 0.0, s - m_cur))
+            p = jnp.where(valid, p, 0.0)  # fully-masked rows stay zero
+        else:
+            p = jnp.exp(s - m_cur)  # masked entries: exp(-1e30 - m) == 0
         l_cur = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         if dropout_rate > 0.0:
             keep = _keep_mask(seed_ref[0], g, i, j, bq, bk, dropout_rate)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
-        v = v_ref[0].astype(jnp.float32)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[0]
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_scr[...] = acc_scr[...] * corr + pv
         m_scr[...] = jnp.broadcast_to(m_cur, m_scr.shape)
@@ -297,10 +307,9 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        k = k_ref[0]
+        s = jax.lax.dot_general(q_ref[0], k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
                             qseg_ref[0] if has_seg else None,
                             kseg_ref[0] if has_seg else None)
@@ -308,9 +317,8 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(valid, s, _NEG_INF)
         lse = lse_ref[0][:, :1]
         p = jnp.exp(s - lse)  # lse=+inf on dead rows → p = 0
-        do = do_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do_ref[0], v_ref[0],
+                                 (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             # d(softmax) sees the dropout-masked upstream cotangent
@@ -319,7 +327,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta)
         dq_scr[...] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(j == nj - 1)
@@ -345,10 +353,10 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(live)
     def _step():
-        q = q_ref[0].astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        q = q_ref[0]
+        do = do_ref[0]
+        s = jax.lax.dot_general(q, k_ref[0], (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         valid = _block_mask(i, j, bq, bk, sq, sk, causal, has_seg,
                             qseg_ref[0] if has_seg else None,
                             kseg_ref[0] if has_seg else None)
@@ -356,7 +364,6 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(valid, s, _NEG_INF)
         lse = lse_ref[0][:, :1]
         p = jnp.exp(s - lse)
-        do = do_ref[0].astype(jnp.float32)
         if dropout_rate > 0.0:
             keep = _keep_mask(seed_ref[0], g, i, j, bq, bk, dropout_rate)
             inv = 1.0 / (1.0 - dropout_rate)
@@ -365,19 +372,16 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             p_kept = p
         # dv sees the dropped-and-rescaled probabilities (O = P_kept V)
         dv_scr[...] += jax.lax.dot_general(
-            p_kept, do, (((0,), (0,)), ((), ())),
+            p_kept.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
             dp = jnp.where(keep, dp * inv, 0.0)
         delta = delta_ref[0][:, :1]
         ds = p * (dp - delta)
-        # q was pre-scaled, so ds·q already carries one factor of scale —
-        # dk = dsᵀ (q·scale) is exactly the chain-rule result
-        dk_scr[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+        dk_scr[...] += scale * jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(i == ni - 1)
@@ -510,7 +514,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     dropout_rate: float = 0.0,
                     dropout_seed=None,
-                    block_q: int = _DEFAULT_BLOCK,
+                    block_q: int = _DEFAULT_BLOCK_Q,
                     block_k: int = _DEFAULT_BLOCK):
     """Fused attention: softmax(q kᵀ · scale [+ masks]) [dropout] v, never
     materializing the score matrix.

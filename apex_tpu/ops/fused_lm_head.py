@@ -75,9 +75,9 @@ def _fwd_kernel(h_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         t_scr[...] = jnp.zeros_like(t_scr)
 
-    h = h_ref[...].astype(jnp.float32)          # [Tb, h]
-    e = e_ref[...].astype(jnp.float32)          # [Vb, h]
-    s = jax.lax.dot_general(h, e, (((1,), (1,)), ((), ())),
+    # operands stay in the input dtype: bf16 hits the MXU at native rate
+    # with fp32 accumulation (an fp32 upcast forces the slow fp32 path)
+    s = jax.lax.dot_general(h_ref[...], e_ref[...], (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # [Tb, Vb]
     tb = s.shape[0]
     col = j * vb + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
@@ -165,9 +165,8 @@ def _dh_kernel(h_ref, e_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
     def _init():
         dh_scr[...] = jnp.zeros_like(dh_scr)
 
-    h = h_ref[...].astype(jnp.float32)
-    e = e_ref[...].astype(jnp.float32)
-    s = jax.lax.dot_general(h, e, (((1,), (1,)), ((), ())),
+    e = e_ref[...]
+    s = jax.lax.dot_general(h_ref[...], e, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     tb = s.shape[0]
     col = j * vb + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
@@ -177,7 +176,8 @@ def _dh_kernel(h_ref, e_ref, lab_ref, lse_ref, g_ref, dh_ref, dh_scr,
     lab = lab_ref[...][:, :1]
     g = g_ref[...][:, :1]                       # upstream per-token cotangent
     dlog = (p - jnp.where(col == lab, 1.0, 0.0)) * g
-    dh_scr[...] += jax.lax.dot_general(dlog, e, (((1,), (0,)), ((), ())),
+    dh_scr[...] += jax.lax.dot_general(dlog.astype(e.dtype), e,
+                                       (((1,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
 
     @pl.when(j == nj - 1)
@@ -194,9 +194,8 @@ def _de_kernel(h_ref, e_ref, lab_ref, lse_ref, g_ref, de_ref, de_scr,
     def _init():
         de_scr[...] = jnp.zeros_like(de_scr)
 
-    h = h_ref[...].astype(jnp.float32)
-    e = e_ref[...].astype(jnp.float32)
-    s = jax.lax.dot_general(h, e, (((1,), (1,)), ((), ())),
+    h = h_ref[...]
+    s = jax.lax.dot_general(h, e_ref[...], (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
     tb = s.shape[0]
     col = j * vb + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
@@ -206,7 +205,8 @@ def _de_kernel(h_ref, e_ref, lab_ref, lse_ref, g_ref, de_ref, de_scr,
     lab = lab_ref[...][:, :1]
     g = g_ref[...][:, :1]
     dlog = (p - jnp.where(col == lab, 1.0, 0.0)) * g
-    de_scr[...] += jax.lax.dot_general(dlog, h, (((0,), (0,)), ((), ())),
+    de_scr[...] += jax.lax.dot_general(dlog.astype(h.dtype), h,
+                                       (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
 
     @pl.when(i == ni - 1)

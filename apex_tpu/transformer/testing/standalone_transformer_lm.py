@@ -69,13 +69,19 @@ class ParallelMLP(nn.Module):
     @nn.compact
     @jax.named_scope("parallel_mlp")
     def __call__(self, x):
+        from jax.ad_checkpoint import checkpoint_name
+
         ffn = self.ffn_hidden_size or 4 * self.hidden_size
         h, bias = ColumnParallelLinear(
             self.hidden_size, ffn, gather_output=False, skip_bias_add=True,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="dense_h_to_4h")(x)
-        h = nn.gelu(h + bias.astype(h.dtype), approximate=True)
+        # named for the 'except_activations' remat policy: the 4h gelu
+        # output is the largest per-layer residual and recomputes
+        # elementwise from the (saved) matmul output
+        h = checkpoint_name(nn.gelu(h + bias.astype(h.dtype),
+                                    approximate=True), "mlp_act")
         out = RowParallelLinear(
             ffn, self.hidden_size, input_is_parallel=True,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
@@ -280,10 +286,12 @@ class ParallelTransformerLayer(nn.Module):
     @nn.compact
     def __call__(self, x, attention_mask=None, deterministic: bool = True,
                  segment_ids=None):
-        ln1 = FusedLayerNorm(
+        from jax.ad_checkpoint import checkpoint_name
+
+        ln1 = checkpoint_name(FusedLayerNorm(
             self.hidden_size,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
-            axis_name=self.axis_name, name="input_layernorm")(x)
+            axis_name=self.axis_name, name="input_layernorm")(x), "ln_out")
         attn = ParallelAttention(
             self.hidden_size, self.num_attention_heads,
             attn_mask_type=self.attn_mask_type, apply_rope=self.apply_rope,
@@ -296,10 +304,11 @@ class ParallelTransformerLayer(nn.Module):
         if self.hidden_dropout > 0.0 and not deterministic:
             attn = nn.Dropout(self.hidden_dropout)(attn, deterministic=False)
         x = x + attn
-        ln2 = FusedLayerNorm(
+        ln2 = checkpoint_name(FusedLayerNorm(
             self.hidden_size,
             sequence_parallel_enabled=self.sequence_parallel_enabled,
-            axis_name=self.axis_name, name="post_attention_layernorm")(x)
+            axis_name=self.axis_name, name="post_attention_layernorm")(x),
+            "ln_out")
         if self.moe_num_experts:
             if self.sequence_parallel_enabled:
                 raise NotImplementedError(
@@ -361,6 +370,13 @@ class ParallelTransformer(nn.Module):
                 "dots": jax.checkpoint_policies.checkpoint_dots,
                 "dots_no_batch":
                     jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                # save EVERYTHING except the tagged cheap-to-recompute
+                # activations (gelu output, LN outputs): unlike 'dots',
+                # custom_vjp outputs (flash attention, LN residuals) stay
+                # saved, so backward recompute is elementwise-only
+                "except_activations":
+                    jax.checkpoint_policies.save_anything_except_these_names(
+                        "mlp_act", "ln_out"),
             }[self.activations_checkpoint_policy]
             layer_cls = nn.remat(ParallelTransformerLayer,
                                  static_argnums=(3,), policy=policy)

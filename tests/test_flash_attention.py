@@ -112,6 +112,16 @@ def test_cross_attention_lengths(rng):
     _check(q, k, v, rng)
 
 
+def test_causal_more_queries_than_keys(rng):
+    """causal sq > sk: the leading sq-sk query rows see NO keys and must
+    emit exact zeros with zero gradients (regression: the square-causal
+    fast path skipped the row zeroing)."""
+    q, k, v = _rand_qkv(rng, 1, 2, 128, 64, 64)
+    _check(q, k, v, rng, causal=True)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_array_equal(np.asarray(out[:, :, :64]), 0.0)
+
+
 def test_bf16(rng):
     q, k, v = _rand_qkv(rng, 1, 2, 128, 128, 64, jnp.bfloat16)
     out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)

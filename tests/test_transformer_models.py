@@ -189,6 +189,7 @@ def test_gpt_rope_variant(rng):
     dict(activations_checkpoint=True),
     dict(activations_checkpoint_policy="dots"),
     dict(activations_checkpoint_policy="dots_no_batch"),
+    dict(activations_checkpoint_policy="except_activations"),
 ])
 def test_gpt_activation_checkpointing_same_loss(rng, kwargs):
     ids = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)

@@ -7,8 +7,11 @@ Variants:
   b12 / b16     — larger batch, no remat
   b16_remat     — batch 16, per-layer remat
   b16_dots      — batch 16, checkpoint_dots policy remat
+  b16_xacts / b12_xacts — except_activations policy (save everything but
+                  tagged gelu/LN outputs; elementwise-only recompute)
   packed_lamb   — b=8, FusedLAMB(packed=True)
   b12_remat     — batch 12, per-layer remat
+  large_b<N>[_remat|_dots] — GPT-2 large (774M, 36x1280) at batch N
 
 --mem-only: print compiled memory analysis and exit (no run).
 """
@@ -34,9 +37,12 @@ def main():
 
     num_layers, hidden, heads, vocab, seq = 24, 1024, 16, 50304, 1024
     batch = {"b12": 12, "b16": 16, "b16_remat": 16, "b16_dots": 16,
-             "b12_remat": 12, "b12_dots": 12}.get(variant, 8)
+             "b12_remat": 12, "b12_dots": 12, "b16_xacts": 16,
+             "b12_xacts": 12}.get(variant, 8)
     remat = variant in ("b16_remat", "b12_remat")
-    policy = "dots" if variant.endswith("_dots") else None
+    policy = ("dots" if variant.endswith("_dots")
+              else "except_activations" if variant.endswith("_xacts")
+              else None)
     packed = variant == "packed_lamb"
     if variant.startswith("large"):  # GPT-2 large (774M)
         num_layers, hidden, heads = 36, 1280, 20

@@ -1,8 +1,11 @@
 """Transformer-body component timings on the real chip at bench shapes.
 
 Small ops sit below the tunnel's per-dispatch floor (~2.5 ms), so each
-measurement runs ITERS chained iterations inside one jitted lax.scan (the
-op output feeds the next input, defeating DCE) and divides by ITERS.
+measurement runs chained iterations inside a jitted lax.scan (the op
+output feeds the next input, defeating DCE), and the per-iter cost is the
+marginal between a 2*ITERS-length scan and an ITERS-length scan — two
+separately-compiled programs whose difference cancels the per-call
+dispatch/readback.
 
 Usage: python tools/layer_bench.py [attn|attn_blk|layer|ln ...]
 """
@@ -20,65 +23,84 @@ import numpy as np
 ITERS = 50
 
 
-def timed(jitted, *args):
-    """One compiled call containing ITERS iterations; returns ms/iter."""
-    out = jitted(*args)
-    jax.block_until_ready(out)
+def _force(out):
+    """block_until_ready can return early on the axon tunnel (round-1
+    postmortem); a scalar readback forces the chain."""
+    return float(jax.tree.leaves(out)[0].ravel()[0])
+
+
+def timed(make_run, *args):
+    """make_run(n) -> jit running n chained iterations.  ms/iter from the
+    marginal t(2*ITERS) - t(ITERS): identical-call marginals do NOT cancel
+    the per-call dispatch floor (both calls carry it), but the scan-length
+    marginal does."""
+    short, long_ = make_run(ITERS), make_run(2 * ITERS)
+    _force(short(*args)); _force(long_(*args))  # compile both
     t0 = time.perf_counter()
-    out = jitted(*args)
-    jax.block_until_ready(out)
+    _force(short(*args))
     t1 = time.perf_counter()
-    return (t1 - t0) / ITERS * 1e3
+    _force(long_(*args))
+    t2 = time.perf_counter()
+    return ((t2 - t1) - (t1 - t0)) / ITERS * 1e3
 
 
 def scan_fwd(op):
-    """x -> op(x) chained ITERS times (shapes must match)."""
+    """n -> jit of n chained op applications (shapes must match)."""
 
-    @jax.jit
-    def run(x):
-        def body(x, _):
-            return op(x), None
+    def make(n):
+        @jax.jit
+        def run(x):
+            def body(x, _):
+                return op(x), None
 
-        y, _ = jax.lax.scan(body, x, None, length=ITERS)
-        return y
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return y
 
-    return run
+        return run
+
+    return make
 
 
 def scan_grad(loss_fn):
-    """Chains grad evaluations of loss_fn(x): x_{i+1} = x_i + 1e-30*g_i."""
+    """Chained grad evaluations of loss_fn(x): x_{i+1} = x_i + 1e-30*g_i."""
 
-    @jax.jit
-    def run(x):
-        def body(x, _):
-            g = jax.grad(loss_fn)(x)
-            return jax.tree.map(lambda a, b: a + 1e-30 * b.astype(a.dtype),
-                                x, g), None
+    def make(n):
+        @jax.jit
+        def run(x):
+            def body(x, _):
+                g = jax.grad(loss_fn)(x)
+                return jax.tree.map(
+                    lambda a, b: a + 1e-30 * b.astype(a.dtype), x, g), None
 
-        y, _ = jax.lax.scan(body, x, None, length=ITERS)
-        return y
+            y, _ = jax.lax.scan(body, x, None, length=n)
+            return y
 
-    return run
+        return run
+
+    return make
 
 
 def scan_grad2(loss_fn):
-    """Chains grad evaluations of loss_fn(params, x) wrt BOTH arguments —
+    """Chained grad evaluations of loss_fn(params, x) wrt BOTH arguments —
     wgrads are ~1/3 of a training backward and must not be DCE'd."""
 
-    @jax.jit
-    def run(params, x):
-        def body(carry, _):
-            params, x = carry
-            gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, x)
-            params = jax.tree.map(
-                lambda a, b: a + 1e-30 * b.astype(a.dtype), params, gp)
-            x = x + 1e-30 * gx.astype(x.dtype)
-            return (params, x), None
+    def make(n):
+        @jax.jit
+        def run(params, x):
+            def body(carry, _):
+                params, x = carry
+                gp, gx = jax.grad(loss_fn, argnums=(0, 1))(params, x)
+                params = jax.tree.map(
+                    lambda a, b: a + 1e-30 * b.astype(a.dtype), params, gp)
+                x = x + 1e-30 * gx.astype(x.dtype)
+                return (params, x), None
 
-        out, _ = jax.lax.scan(body, (params, x), None, length=ITERS)
-        return out
+            out, _ = jax.lax.scan(body, (params, x), None, length=n)
+            return out
 
-    return run
+        return run
+
+    return make
 
 
 def main():

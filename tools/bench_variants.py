@@ -81,9 +81,11 @@ def main():
             "variant": variant, "batch": batch,
             "temp_gb": round(mem.temp_size_in_bytes / 2**30, 2),
             "arg_gb": round(mem.argument_size_in_bytes / 2**30, 2),
+            # donated params/state alias their outputs — subtract
             "total_gb": round((mem.temp_size_in_bytes
                                + mem.argument_size_in_bytes
-                               + mem.output_size_in_bytes) / 2**30, 2)}))
+                               + mem.output_size_in_bytes
+                               - mem.alias_size_in_bytes) / 2**30, 2)}))
         return
 
     def run(n):

@@ -235,9 +235,11 @@ def _pallas_bwd(h2, e, labels, lse, g, tb, vb):
     # <= 256, rather than falling back to one whole-token tile.
     while tb > 256 and tb % 2 == 0:
         tb //= 2
-    # the vocab tile scales inversely with hidden: at hid=1280 a 1024-wide
-    # tile overflows VMEM by 144 KB (measured, GPT-2-large)
-    vb = min(vb, max(128, (1024 * 1024 // hid) // 128 * 128))
+    # the vocab tile shrinks with hidden (the e tile and accumulator
+    # scratch scale with vb*hid: at hid=1280 a 1024-wide tile overflows
+    # VMEM by 144 KB, measured on GPT-2-large) — but never grows past the
+    # 1024 cap (the fp32 score/dlog tiles scale with tb*vb regardless)
+    vb = min(vb, 1024, max(128, (1024 * 1024 // hid) // 128 * 128))
     ep, vocab = _pad_vocab(e, vb)
     vp = ep.shape[0]
     lab3 = _lane_tile(labels, jnp.int32)

@@ -1,0 +1,111 @@
+"""Pair-bias flash attention kernel parity (ops/pair_bias_attention.py).
+
+Values and all four gradients (dq, dk, dv, dbias — dbias reduces over the
+broadcast MSA-row dim) must match the materialized reference, with and
+without a kv mask, including fully-masked rows.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.pair_bias_attention import (
+    pair_bias_flash_attention,
+    pair_bias_reference,
+)
+
+
+@pytest.fixture(autouse=True)
+def _interpret_kernels(monkeypatch):
+    monkeypatch.setenv("APEX_TPU_KERNELS", "interpret")
+    yield
+
+
+def _inputs(rng, r=3, b=2, h=2, s=128, d=32, dtype=jnp.float32,
+            with_mask=False):
+    R = r * b
+    q = jnp.asarray(rng.standard_normal((R, h, s, d)) * 0.5, dtype)
+    k = jnp.asarray(rng.standard_normal((R, h, s, d)) * 0.5, dtype)
+    v = jnp.asarray(rng.standard_normal((R, h, s, d)) * 0.5, dtype)
+    bias = jnp.asarray(rng.standard_normal((b, h, s, s)) * 0.5, dtype)
+    mask = None
+    if with_mask:
+        m = rng.random((R, s)) > 0.2
+        m[0, :] = False          # one fully-masked row batch entry
+        mask = jnp.asarray(m)
+    return q, k, v, bias, mask
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_forward_matches_reference(rng, with_mask):
+    q, k, v, bias, mask = _inputs(rng, with_mask=with_mask)
+    out = pair_bias_flash_attention(q, k, v, bias, mask, block_q=64,
+                                    block_k=64)
+    ref = pair_bias_reference(q, k, v, bias, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    if with_mask:
+        np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_gradients_match_reference(rng, with_mask):
+    q, k, v, bias, mask = _inputs(rng, with_mask=with_mask)
+    do = jnp.asarray(rng.standard_normal(q.shape), q.dtype)
+
+    def loss_flash(q, k, v, bias):
+        y = pair_bias_flash_attention(q, k, v, bias, mask, block_q=64,
+                                      block_k=64)
+        return jnp.sum(y.astype(jnp.float32) * do.astype(jnp.float32))
+
+    def loss_ref(q, k, v, bias):
+        y = pair_bias_reference(q, k, v, bias, mask)
+        return jnp.sum(y.astype(jnp.float32) * do.astype(jnp.float32))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for name, a, b_ in zip("q k v bias".split(), gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_bf16_runs(rng):
+    q, k, v, bias, mask = _inputs(rng, dtype=jnp.bfloat16)
+    out = pair_bias_flash_attention(q, k, v, bias, mask, block_q=64,
+                                    block_k=64)
+    ref = pair_bias_reference(q, k, v, bias, mask)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=3e-2, atol=3e-2)
+
+
+def test_openfold_attention_core_routes_through_kernel(rng):
+    """The 5-D openfold entrypoint must dispatch to the Pallas kernel for
+    long sequences (s >= 1024 — below that the measured winner is the
+    materialized XLA path and routing must NOT engage) and match the
+    materialized semantics."""
+    from apex_tpu.contrib.openfold_triton import attention_core
+
+    b, r, h, s, d = 1, 2, 1, 1024, 8
+    q = jnp.asarray(rng.standard_normal((b, r, h, s, d)) * 0.3, jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, r, h, s, d)) * 0.3, jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, r, h, s, d)) * 0.3, jnp.float32)
+    bias = jnp.asarray(rng.standard_normal((b, 1, h, s, s)) * 0.3,
+                       jnp.float32)
+    mask = jnp.asarray(rng.random((b, r, 1, 1, s)) > 0.1)
+
+    out = attention_core(q, k, v, mask=mask, bias=bias)
+    jaxpr = str(jax.make_jaxpr(
+        lambda *a: attention_core(a[0], a[1], a[2], mask=a[3], bias=a[4]))(
+        q, k, v, mask, bias))
+    assert "pallas" in jaxpr or "custom_vjp" in jaxpr
+
+    # reference semantics: materialized softmax with -inf mask fill
+    sc = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32)
+    sc = sc + bias.astype(jnp.float32)
+    sc = jnp.where(mask.astype(bool), sc, -1e9)
+    probs = jax.nn.softmax(sc, axis=-1)
+    ref = jnp.einsum("...qk,...kd->...qd", probs.astype(q.dtype), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)

@@ -8,8 +8,12 @@ optimizers).  This package holds the production-shaped model definitions:
 - :mod:`apex_tpu.models.llama` — Llama-2/3-class causal LM: RMSNorm,
   rotary embeddings, SwiGLU, grouped-query attention, tensor-parallel
   sharding, flash attention, fused LM-head loss.
+- :mod:`apex_tpu.models.vit` — Vision Transformer classifier (patch
+  embedding, pre-LN encoder over the tp layers, fused LN kernels).
 """
 
 from apex_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from apex_tpu.models.vit import ViTConfig, ViTForImageClassification
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM"]
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "ViTConfig",
+           "ViTForImageClassification"]

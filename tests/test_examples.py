@@ -4,17 +4,21 @@ Each runs the real script in a subprocess on the virtual CPU mesh — the
 same way a user would — and checks its own convergence assertions pass.
 """
 
+import os
 import subprocess
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-ENV = {"PATH": "/usr/bin:/bin:/usr/local/bin",
+# extend (not replace) the environment: a from-scratch dict hardcodes
+# HOME/PATH and drops TMPDIR/proxies for non-root users.  PYTHONPATH is
+# overridden on purpose — it removes the axon sitecustomize so the
+# subprocess gets a plain CPU jax.
+ENV = {**os.environ,
        "JAX_PLATFORMS": "cpu",
        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-       "PYTHONPATH": str(REPO),
-       "HOME": "/root"}
+       "PYTHONPATH": str(REPO)}
 
 
 def test_dcgan_amp_two_optimizers():
@@ -35,3 +39,14 @@ def test_bert_pretrain_dp():
         capture_output=True, text=True, timeout=600, env=ENV)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "bert pretrain OK: dp=8" in out.stdout
+
+
+def test_llama_pretrain_tp_dp():
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "llama" / "pretrain.py"),
+         "--steps", "6", "--layers", "2", "--hidden", "64", "--heads", "4",
+         "--kv-heads", "2", "--ffn", "128", "--vocab", "256", "--seq", "64",
+         "--batch", "8", "--tp", "2"],
+        capture_output=True, text=True, timeout=600, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "llama pretrain OK: dp=4 tp=2" in out.stdout

@@ -9,6 +9,14 @@ trust ratio ||p|| / ||update|| scaling the learning rate.
 
 ``use_nvlamb=True`` applies the trust ratio even for tensors excluded from
 weight decay (the NVLAMB variant note in fused_lamb.py).
+
+``packed=True`` scale caveat (r3, measured): at GPT-2-medium scale (355M
+params) the packed step did not complete a 25-step timing run within 30
+minutes on a v5e — the phase-2 per-tensor trust ratios run segment
+reductions over the full flat buffer, which XLA lowers to scatter-based
+code that degrades badly at hundreds of millions of elements.  The
+default (unpacked) path is the production path and the bench flagship
+configuration; packed is tested and fine at the scales its tests cover.
 """
 
 from __future__ import annotations

@@ -13,7 +13,15 @@ optimizers).  This package holds the production-shaped model definitions:
 """
 
 from apex_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from apex_tpu.models.llama_pipeline import (
+    LlamaPipeConfig,
+    build_llama_pipeline,
+    init_llama_pipeline_params,
+    make_llama_3d_train_step,
+)
 from apex_tpu.models.vit import ViTConfig, ViTForImageClassification
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM", "ViTConfig",
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaPipeConfig",
+           "build_llama_pipeline", "init_llama_pipeline_params",
+           "make_llama_3d_train_step", "ViTConfig",
            "ViTForImageClassification"]

@@ -1,0 +1,193 @@
+"""Pipeline-parallel stage decomposition of the Llama decoder.
+
+Completes the BASELINE.md row 5 component set ("Llama-2 7B, TP x PP"):
+the same slicing :func:`apex_tpu.transformer.testing.commons.build_gpt_pipeline`
+does for GPT, applied to the Llama architecture — VocabParallelEmbedding as
+the first-stage adapter, ``layers_per_stage`` :class:`LlamaDecoderLayer`
+blocks as the repeated stage body, and final RMSNorm + vocab-sharded LM head
++ vocab-parallel CE as the last stage.  Composes with any of the pipeline
+schedules (1F1B in ``examples/llama/pretrain.py --pp``), tp (+ sequence
+parallelism) inside each stage, and dp outside.
+
+Reference parity: the stacking spec is the reference's
+``apex/transformer/testing/standalone_transformer_lm.py`` (model slicing for
+pipeline tests); the architecture is Llama (RMSNorm + rope + GQA + SwiGLU)
+rather than the reference's GPT toy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.models.llama import LlamaConfig, LlamaDecoderLayer
+from apex_tpu.normalization import FusedRMSNorm
+from apex_tpu.transformer.parallel_state import TENSOR_PARALLEL_AXIS
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    PipelineStageSpec,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    VocabParallelEmbedding,
+    parallel_lm_logits,
+    shard_init,
+    tp_world_size,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+__all__ = ["LlamaPipeConfig", "build_llama_pipeline",
+           "init_llama_pipeline_params", "make_llama_3d_train_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaPipeConfig:
+    config: LlamaConfig
+    layers_per_stage: int = 2
+    sequence_parallel_enabled: bool = False
+    params_dtype: Any = jnp.float32
+    axis_name: str = TENSOR_PARALLEL_AXIS
+
+
+class _Embed(nn.Module):
+    pcfg: LlamaPipeConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        cfg = self.pcfg.config
+        x = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size,
+            params_dtype=self.pcfg.params_dtype,
+            axis_name=self.pcfg.axis_name, name="embed_tokens")(input_ids)
+        x = x.transpose(1, 0, 2)  # [s, b, h] wire layout
+        if self.pcfg.sequence_parallel_enabled:
+            from apex_tpu.transformer.tensor_parallel import (
+                scatter_to_sequence_parallel_region,
+            )
+
+            x = scatter_to_sequence_parallel_region(x, self.pcfg.axis_name)
+        return x
+
+
+class _StageBlock(nn.Module):
+    pcfg: LlamaPipeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.pcfg.layers_per_stage):
+            x = LlamaDecoderLayer(
+                self.pcfg.config,
+                sequence_parallel_enabled=self.pcfg.sequence_parallel_enabled,
+                params_dtype=self.pcfg.params_dtype,
+                axis_name=self.pcfg.axis_name, name=f"layers_{i}")(x)
+        return x
+
+
+class _Head(nn.Module):
+    pcfg: LlamaPipeConfig
+
+    @nn.compact
+    def __call__(self, y, labels):
+        cfg = self.pcfg.config
+        y = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
+                         param_dtype=self.pcfg.params_dtype, name="norm")(y)
+        head = self.param(
+            "lm_head",
+            shard_init(nn.initializers.normal(0.02), self.pcfg.axis_name),
+            (divide(cfg.vocab_size, tp_world_size(self.pcfg.axis_name)),
+             cfg.hidden_size), self.pcfg.params_dtype)
+        logits = parallel_lm_logits(
+            y, head.astype(y.dtype), self.pcfg.axis_name,
+            sequence_parallel_enabled=self.pcfg.sequence_parallel_enabled)
+        loss = vocab_parallel_cross_entropy(
+            logits.transpose(1, 0, 2), labels,
+            axis_name=self.pcfg.axis_name)
+        return loss.mean()
+
+
+def build_llama_pipeline(pcfg: LlamaPipeConfig) -> PipelineStageSpec:
+    """A :class:`PipelineStageSpec` for the SPMD pipeline schedules.
+
+    Params pytree (per pp×tp rank): ``{"embed", "block", "head"}`` —
+    embed/head are replicated across pp (their grads psum over the pp axis,
+    the reference's embedding-group allreduce); block is per-stage.
+    Microbatch pytree: ``{"ids": [b, s] int32, "labels": [b, s] int32}``.
+    """
+    embed = _Embed(pcfg)
+    block = _StageBlock(pcfg)
+    head = _Head(pcfg)
+
+    def first_fn(params, mb):
+        return embed.apply(params["embed"], mb["ids"])
+
+    def stage_fn(params, x):
+        return block.apply(params["block"], x)
+
+    def last_fn(params, y, mb):
+        return head.apply(params["head"], y, mb["labels"])
+
+    return PipelineStageSpec(stage_fn=stage_fn, first_fn=first_fn,
+                             last_fn=last_fn)
+
+
+def make_llama_3d_train_step(pcfg: LlamaPipeConfig, opt, schedule):
+    """(init_fn, train_step) for a dp × pp × tp mesh — call both inside
+    ``shard_map``.
+
+    Encodes the 3D gradient-reduction contract in ONE place (used by both
+    ``examples/llama/pretrain.py --pp`` and the driver's multichip dryrun):
+    dp grads pmean; embed/head grads psum over pp (they replicate across
+    stages — the reference's embedding-group allreduce); block grads are
+    per-stage and must NOT be reduced (the invariant
+    tests/test_hlo_comm_plan.py::test_1f1b_collective_plan_is_exact pins).
+
+    ``schedule`` is any pipeline fwd/bwd function with the
+    ``(spec, params, batches) -> (loss, grads)`` signature (1F1B in both
+    callers).  Microbatches: ``{"ids": [n_micro, b, s], "labels": ...}``.
+    """
+    spec = build_llama_pipeline(pcfg)
+
+    def init_fn(key, batches):
+        params = init_llama_pipeline_params(pcfg, key, batches["ids"][0])
+        return params, opt.init(params)
+
+    def train_step(params, opt_state, batches):
+        loss, grads = schedule(spec, params, batches)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        grads = {
+            "embed": jax.tree.map(lambda g: jax.lax.psum(g, "pp"),
+                                  grads["embed"]),
+            "head": jax.tree.map(lambda g: jax.lax.psum(g, "pp"),
+                                 grads["head"]),
+            "block": grads["block"],
+        }
+        loss = jax.lax.pmean(loss, "dp")
+        new_params, new_state = opt.step(grads, params, opt_state)
+        return new_params, new_state, loss
+
+    return init_fn, train_step
+
+
+def init_llama_pipeline_params(pcfg: LlamaPipeConfig, key, sample_ids) -> Any:
+    """Init one pp-rank's params (call inside shard_map so tp/pp rank-folded
+    init draws the right shards; the pp rank folds into the block key so
+    stages start with independent weights)."""
+    from apex_tpu.transformer.tensor_parallel.layers import maybe_axis_index
+
+    embed = _Embed(pcfg)
+    block = _StageBlock(pcfg)
+    head = _Head(pcfg)
+
+    pp_idx = maybe_axis_index("pp")
+    block_key = key if pp_idx is None else jax.random.fold_in(key, pp_idx)
+
+    embed_params = embed.init(jax.random.fold_in(key, 1), sample_ids)
+    wire = embed.apply(embed_params, sample_ids)
+    block_params = block.init(jax.random.fold_in(block_key, 2), wire)
+    wire2 = block.apply(block_params, wire)
+    labels = jnp.zeros(sample_ids.shape, jnp.int32)
+    head_params = head.init(jax.random.fold_in(key, 3), wire2, labels)
+    return {"embed": embed_params, "block": block_params, "head": head_params}

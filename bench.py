@@ -6,19 +6,21 @@ Prints ONE JSON line:
 The flagship config is a GPT-2-large (774M) causal LM trained with the
 full apex_tpu stack (flash attention, fused LN kernels, fused LM-head CE
 kernel, FusedLAMB — the BASELINE.md north-star optimizer, bf16 O2 policy,
-donated buffers).  ``vs_baseline`` is measured MFU / 0.45 (the
-BASELINE.md target), so 1.0 means the target is met — r3 measured 0.4503
-MFU (vs_baseline 1.0007).
+donated buffers).  ``--model 1.3b`` runs a GPT 1.3B on the same single
+chip (activation recompute + bf16 LAMB moments to fit 16 GB HBM).
 
-Config note vs BASELINE.md's GPT-2 1.3B TP=8 flagship: this environment
-exposes ONE v5e chip (16 GB HBM), and 1.3B with LAMB fp32 state needs
-~18 GB — it cannot run un-sharded here.  GPT-2 large (774M) is the
-largest config of the same family that fits with full fp32 LAMB state
-and NO activation recompute (~14.7 GB live with donated buffers;
-VERDICT r2 item 2); the TP=8 sharding itself is validated by
-``--tp 8 --dryrun`` (collective plan + per-chip memory at 1.3B shapes),
-on the 8-device CPU mesh (tests/test_hlo_comm_plan.py), and by the
-driver's multichip dryrun.
+``vs_baseline`` is measured MFU / 0.45 (the BASELINE.md target), so 1.0
+means the target is met.  This definition is fixed as of r3 (r2 used a
+tokens/s ratio; see BASELINE.md "vs_baseline semantics").
+
+Robustness (VERDICT r3 item 1): the axon tunnel throws transient
+``INTERNAL: remote_compile`` / stream errors that killed round 3's
+capture.  Every config attempt is wrapped in bounded retries that
+rebuild params/opt_state from scratch (donation invalidates them) and
+clear jit caches; after exhausting retries the bench falls back to the
+next smaller model so the driver ALWAYS gets a JSON line, with
+``fallback``/``attempts``/``errors`` recording what happened.  Only if
+every config fails does it print an ``ok: false`` line and exit 1.
 
 Measurement notes (round-1 postmortem): on the tunneled TPU platform,
 ``jax.block_until_ready`` can return before the computation actually runs,
@@ -43,6 +45,7 @@ import re
 import subprocess
 import sys
 import time
+import traceback
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +54,36 @@ import numpy as np
 # v5e: 197 TFLOP/s bf16 per chip; v5p: 459; v4: 275 (public specs)
 _PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0,
                 "v6": 918.0}
+
+# Model cards.  remat/state_dtype are the memory levers that let each
+# config fit one 16 GB v5e chip (PERF_NOTES.md has the accounting).
+_CONFIGS = {
+    # 774M: fits with full fp32 LAMB state and NO activation recompute
+    "large": dict(layers=36, hidden=1280, heads=20, vocab=50304,
+                  seq=1024, batch=8, steps=8,
+                  remat=None, state_dtype="float32"),
+    # 355M: the r2 flagship, kept as the fallback config
+    "medium": dict(layers=24, hidden=1024, heads=16, vocab=50304,
+                   seq=1024, batch=8, steps=8,
+                   remat=None, state_dtype="float32"),
+    # 1.3B: bf16 moments (fused_lamb.py state_dtype) + per-layer remat;
+    # fp32 m+v alone would be 10.6 GB, activations-without-remat ~3 GB
+    "1.3b": dict(layers=24, hidden=2048, heads=32, vocab=50304,
+                 seq=1024, batch=8, steps=4,
+                 remat="except_activations", state_dtype="bfloat16"),
+    "cpu-smoke": dict(layers=2, hidden=128, heads=4, vocab=1024,
+                      seq=128, batch=2, steps=2,
+                      remat=None, state_dtype="float32"),
+}
+
+# transient runtime errors worth retrying (observed: BENCH_r03.json died
+# on "INTERNAL: ... remote_compile"; also seen: stream/tunnel resets).
+# RESOURCE_EXHAUSTED (OOM) is deliberately NOT here: it is deterministic,
+# and the right move is the next-smaller config, not a retry.
+_TRANSIENT_MARKERS = (
+    "remote_compile", "INTERNAL", "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "Socket", "stream", "Connection",
+)
 
 
 def _peak_tflops(device) -> float:
@@ -61,30 +94,35 @@ def _peak_tflops(device) -> float:
     return 197.0  # assume v5e-class
 
 
-def main() -> None:
+def run_config(name: str, *, batch: int | None = None,
+               steps: int | None = None) -> dict:
+    """Build everything from scratch, run the timing protocol, return the
+    result dict.  Raises on any failure — the caller owns retry policy."""
     from apex_tpu.optimizers import FusedLAMB
     from apex_tpu.transformer.testing import GPTModel
+
+    cfg = dict(_CONFIGS[name])
+    if batch:
+        cfg["batch"] = batch
+    if steps:
+        cfg["steps"] = steps
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     n_chips = jax.device_count()
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
-    if on_tpu:
-        # GPT-2 large (774M): the largest GPT-2-family config that fits one
-        # v5e chip with full fp32 LAMB state and no activation recompute
-        num_layers, hidden, heads, vocab, seq, batch = 36, 1280, 20, 50304, 1024, 8
-        steps, dtype = 8, jnp.bfloat16
-    else:  # CPU smoke sizing
-        num_layers, hidden, heads, vocab, seq, batch = 2, 128, 4, 1024, 128, 2
-        steps, dtype = 2, jnp.float32
-
-    model = GPTModel(num_layers=num_layers, hidden_size=hidden,
-                     num_attention_heads=heads, vocab_size=vocab,
-                     max_sequence_length=seq, params_dtype=jnp.float32)
-    opt = FusedLAMB(lr=1e-3)
+    model = GPTModel(
+        num_layers=cfg["layers"], hidden_size=cfg["hidden"],
+        num_attention_heads=cfg["heads"], vocab_size=cfg["vocab"],
+        max_sequence_length=cfg["seq"], params_dtype=jnp.float32,
+        activations_checkpoint=bool(cfg["remat"]),
+        activations_checkpoint_policy=cfg["remat"])
+    opt = FusedLAMB(lr=1e-3, state_dtype=jnp.dtype(cfg["state_dtype"]))
 
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    ids = jnp.asarray(rng.integers(0, cfg["vocab"], (cfg["batch"], cfg["seq"])),
+                      jnp.int32)
     labels = jnp.roll(ids, -1, axis=1)
 
     params = model.init(jax.random.PRNGKey(0), ids)
@@ -113,12 +151,13 @@ def main() -> None:
         loss_val = float(loss)
         return time.perf_counter() - t0, loss_val, params, opt_state
 
+    steps_n = cfg["steps"]
     # warmup/compile
     _, loss0, params, opt_state = run(1, params, opt_state)
     assert np.isfinite(loss0), f"non-finite warmup loss {loss0}"
 
-    t_n, loss_n, params, opt_state = run(steps, params, opt_state)
-    t_2n, loss_2n, params, opt_state = run(2 * steps, params, opt_state)
+    t_n, loss_n, params, opt_state = run(steps_n, params, opt_state)
+    t_2n, loss_2n, params, opt_state = run(2 * steps_n, params, opt_state)
 
     # sanity: the model must actually be learning and time must accumulate
     assert loss_2n != loss_n, "loss frozen across steps — step not executing"
@@ -128,14 +167,18 @@ def main() -> None:
     assert t_2n > t_n * 1.2, (
         f"t(2N)={t_2n:.3f} not > t(N)={t_n:.3f}: timing not capturing work")
 
-    step_time = (t_2n - t_n) / steps
-    tokens_per_sec = batch * seq / step_time
+    step_time = (t_2n - t_n) / steps_n
+    tokens_per_sec = cfg["batch"] * cfg["seq"] / step_time
 
     # model FLOPs: 6 * N_params per token (fwd+bwd) + causal attention term
-    # 12 * L * h * s * 1/2 (causal halves the score/context matmuls)
+    # 12 * L * h * s * 1/2 (causal halves the score/context matmuls).
+    # Remat recompute FLOPs are deliberately NOT credited: this is model
+    # FLOPs utilization, not hardware FLOPs — remat configs pay for their
+    # recompute in the measured MFU.
     n_params = sum(int(np.prod(l.shape)) for l in jax.tree.leaves(params)
                    if hasattr(l, "shape"))
-    flops_per_token = 6 * n_params + 12 * num_layers * hidden * seq // 2
+    flops_per_token = (6 * n_params
+                       + 12 * cfg["layers"] * cfg["hidden"] * cfg["seq"] // 2)
     tflops = tokens_per_sec * flops_per_token / 1e12
     peak = _peak_tflops(dev) * n_chips
     mfu = tflops / peak if on_tpu else 0.0
@@ -143,8 +186,8 @@ def main() -> None:
         assert 0.0 < mfu <= 1.0, (
             f"measured MFU {mfu:.3f} is not physical — measurement error")
 
-    result = {
-        "metric": "gpt2_large_tokens_per_sec_per_chip",
+    return {
+        "metric": f"gpt2_{name}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_chips, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
@@ -153,13 +196,79 @@ def main() -> None:
         "step_time_ms": round(step_time * 1e3, 2),
         "n_chips": n_chips,
         "device": str(dev.device_kind),
-        "config": {"layers": num_layers, "hidden": hidden, "heads": heads,
-                   "vocab": vocab, "seq": seq, "batch": batch,
+        "config": {"model": name, "layers": cfg["layers"],
+                   "hidden": cfg["hidden"], "heads": cfg["heads"],
+                   "vocab": cfg["vocab"], "seq": cfg["seq"],
+                   "batch": cfg["batch"],
                    "params_m": round(n_params / 1e6, 1),
                    "optimizer": "FusedLAMB",
+                   "state_dtype": cfg["state_dtype"],
+                   "remat": cfg["remat"],
                    "loss0": round(loss0, 4), "loss_end": round(loss_2n, 4)},
     }
-    print(json.dumps(result))
+
+
+def main(model: str | None, batch: int | None, steps: int | None,
+         attempts_per_config: int = 3, deadline_s: float = 1500.0) -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if model is None:
+        # default chain: flagship, then the proven-smaller fallback
+        chain = ["large", "medium"] if on_tpu else ["cpu-smoke"]
+    else:
+        chain = [model]  # explicit --model is honored on ANY platform
+
+    t_start = time.monotonic()
+    errors: list[str] = []
+    n_attempts = 0
+    deadline_hit = False
+    for config in chain:
+        if deadline_hit:
+            break
+        for _ in range(attempts_per_config):
+            if n_attempts and time.monotonic() - t_start > deadline_s:
+                errors.append(f"deadline {deadline_s}s exceeded; "
+                              "not starting another attempt")
+                deadline_hit = True
+                break
+            n_attempts += 1
+            try:
+                result = run_config(config, batch=batch, steps=steps)
+                result["attempts"] = n_attempts
+                result["fallback"] = config != chain[0]
+                if errors:
+                    result["errors"] = errors
+                print(json.dumps(result))
+                return
+            except Exception as e:  # noqa: BLE001 — the whole point is capture
+                msg = f"{config}: {type(e).__name__}: {e}"
+                errors.append(msg[:500])
+                traceback.print_exc(file=sys.stderr)
+                # AssertionErrors (the sanity gates) can be tunnel flakes —
+                # retry them like transient runtime errors; other hard
+                # errors (OOM, shape bugs) are deterministic, so burn no
+                # budget re-proving that: jump straight to the next config
+                transient = (isinstance(e, AssertionError)
+                             or any(m.lower() in str(e).lower()
+                                    for m in _TRANSIENT_MARKERS))
+                try:
+                    jax.clear_caches()
+                except Exception:
+                    pass
+                if not transient:
+                    print(f"[bench] attempt {n_attempts} failed (hard); "
+                          f"falling back to next config", file=sys.stderr)
+                    break
+                print(f"[bench] attempt {n_attempts} failed (transient); "
+                      f"retrying fresh", file=sys.stderr)
+                time.sleep(5.0)
+
+    # every config failed: still emit one JSON line, then fail loudly
+    print(json.dumps({
+        "metric": "gpt2_bench_failed", "value": 0.0, "unit": "tokens/s/chip",
+        "vs_baseline": 0.0, "ok": False, "attempts": n_attempts,
+        "errors": errors,
+    }))
+    sys.exit(1)
 
 
 def tp_dryrun(tp: int) -> None:
@@ -287,14 +396,30 @@ def tp_dryrun(tp: int) -> None:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=sorted(_CONFIGS), default=None,
+                    help="run ONE config (no fallback chain); default: "
+                    "large with medium fallback")
+    ap.add_argument("--batch", type=int, default=0, help="override batch size")
+    ap.add_argument("--steps", type=int, default=0,
+                    help="override timing-step count")
+    ap.add_argument("--attempts", type=int, default=3,
+                    help="max attempts per config before falling back")
     ap.add_argument("--tp", type=int, default=0,
                     help="tensor-parallel degree for --dryrun")
     ap.add_argument("--dryrun", action="store_true",
                     help="compile-only TP dryrun: per-chip memory + comm plan")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu). NB: the env var "
+                    "JAX_PLATFORMS is frozen at interpreter start by the "
+                    "axon sitecustomize; this flag uses jax.config.update, "
+                    "which still works")
     a = ap.parse_args()
+    if a.platform:
+        jax.config.update("jax_platforms", a.platform)
     if a.dryrun:
         tp_dryrun(a.tp or 8)
     elif a.tp:
         ap.error("--tp requires --dryrun (the single-chip bench ignores it)")
     else:
-        main()
+        main(a.model, a.batch or None, a.steps or None,
+             attempts_per_config=a.attempts)

@@ -9,11 +9,20 @@ over dp, grads pmean over dp, and the model's vocab-parallel CE computes
 the loss with psums under tp.  Synthetic next-token data (zero egress).
 
     python examples/llama/pretrain.py [--tp 2] [--layers 4] [--steps 10]
+
+``--pp N`` switches to the full 3-D dp × pp × tp layout (BASELINE.md
+row 5: "Llama-2 7B, TP x PP"): the decoder is sliced into pipeline stages
+(:mod:`apex_tpu.models.llama_pipeline`) and driven by the true-1F1B
+schedule; embed/head grads psum over pp, block grads stay per-stage:
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+    python examples/llama/pretrain.py --tp 2 --pp 2 --micro-batch 2
 """
 
 from __future__ import annotations
 
 import argparse
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -59,13 +68,32 @@ def main():
     ap.add_argument("--ffn", type=int, default=352)
     ap.add_argument("--vocab", type=int, default=512)
     ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--batch", type=int, default=8)    # global
+    ap.add_argument("--batch", type=int, default=None,
+                    help="global batch (2-D path; default 8). With --pp > 1 "
+                    "the global batch is micro-batch * dp * n-micro — "
+                    "passing --batch there is an error, not silently ignored")
     ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages; > 1 uses the 1F1B schedule over "
+                    "a dp x pp x tp mesh")
+    ap.add_argument("--micro-batch", type=int, default=2,
+                    help="per-dp-rank microbatch size (pp > 1 only)")
+    ap.add_argument("--n-micro", type=int, default=4,
+                    help="microbatches per step (pp > 1 only)")
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--lr", type=float, default=3e-4)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
+    if args.pp > 1:
+        if args.batch is not None:
+            raise SystemExit(
+                "--batch applies to the 2-D path only; with --pp the "
+                "global batch is --micro-batch * dp * --n-micro")
+        return main_3d(args)
+
+    if args.batch is None:
+        args.batch = 8
     devices = jax.devices()
     if len(devices) % args.tp:
         raise SystemExit(f"device count {len(devices)} must be a multiple "
@@ -124,6 +152,69 @@ def main():
 
     assert np.isfinite(last) and last < first, (first, last)
     print(f"llama pretrain OK: dp={dp} tp={args.tp}, "
+          f"loss {first:.4f} -> {last:.4f}")
+    return last
+
+
+def main_3d(args):
+    """dp × pp × tp with the 1F1B schedule (BASELINE.md row 5 layout)."""
+    from apex_tpu.models import LlamaPipeConfig, make_llama_3d_train_step
+    from apex_tpu.transformer import parallel_state
+    from apex_tpu.transformer.pipeline_parallel import (
+        forward_backward_pipelining_1f1b,
+    )
+
+    devices = jax.devices()
+    world = args.tp * args.pp
+    if len(devices) % world:
+        raise SystemExit(f"device count {len(devices)} must be a multiple "
+                         f"of tp*pp={world}")
+    dp = len(devices) // world
+    if args.layers % args.pp:
+        raise SystemExit(f"--layers {args.layers} must divide by "
+                         f"--pp {args.pp}")
+    mesh = parallel_state.initialize_model_parallel(
+        args.tp, args.pp, devices=devices)
+
+    cfg = LlamaConfig(
+        vocab_size=args.vocab, hidden_size=args.hidden,
+        intermediate_size=args.ffn, num_hidden_layers=args.layers,
+        num_attention_heads=args.heads, num_key_value_heads=args.kv_heads,
+        max_position_embeddings=args.seq)
+    pcfg = LlamaPipeConfig(
+        config=cfg, layers_per_stage=args.layers // args.pp,
+        sequence_parallel_enabled=args.tp > 1)
+    opt = FusedAdam(lr=args.lr)
+    init_fn, train_step = make_llama_3d_train_step(
+        pcfg, opt, forward_backward_pipelining_1f1b)
+
+    rng = np.random.default_rng(args.seed)
+    ids = rng.integers(0, args.vocab,
+                       (args.n_micro, args.micro_batch * dp, args.seq))
+    batches = {"ids": jnp.asarray(ids, jnp.int32),
+               "labels": jnp.asarray(np.roll(ids, -1, axis=-1), jnp.int32)}
+    batch_specs = {"ids": P(None, "dp"), "labels": P(None, "dp")}
+
+    with mesh:
+        params, opt_state = jax.jit(shard_map(
+            functools.partial(init_fn, jax.random.PRNGKey(args.seed)),
+            mesh=mesh, in_specs=(batch_specs,), out_specs=P(),
+            check_vma=False))(batches)
+        step = jax.jit(shard_map(
+            train_step, mesh=mesh, in_specs=(P(), P(), batch_specs),
+            out_specs=(P(), P(), P()), check_vma=False))
+        first = last = None
+        for it in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, batches)
+            last = float(loss)
+            first = last if first is None else first
+            if it % 2 == 0 or it == args.steps - 1:
+                print(f"step {it:3d}  loss {last:.4f}  "
+                      f"dp={dp} pp={args.pp} tp={args.tp}")
+    parallel_state.destroy_model_parallel()
+
+    assert np.isfinite(last) and last < first, (first, last)
+    print(f"llama pretrain OK: dp={dp} pp={args.pp} tp={args.tp}, "
           f"loss {first:.4f} -> {last:.4f}")
     return last
 

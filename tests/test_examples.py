@@ -50,3 +50,16 @@ def test_llama_pretrain_tp_dp():
         capture_output=True, text=True, timeout=600, env=ENV)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "llama pretrain OK: dp=4 tp=2" in out.stdout
+
+
+def test_llama_pretrain_3d_tp_pp_dp():
+    """BASELINE.md row 5 component set: Llama over dp x pp x tp with the
+    1F1B schedule (VERDICT r3 item 5)."""
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "llama" / "pretrain.py"),
+         "--steps", "6", "--layers", "4", "--hidden", "64", "--heads", "4",
+         "--kv-heads", "2", "--ffn", "128", "--vocab", "256", "--seq", "32",
+         "--tp", "2", "--pp", "2", "--micro-batch", "2", "--n-micro", "4"],
+        capture_output=True, text=True, timeout=600, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "llama pretrain OK: dp=2 pp=2 tp=2" in out.stdout

@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax import shard_map
-from jax.sharding import PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer.tensor_parallel import (
@@ -74,8 +74,11 @@ def _compiled_hlo(mesh, sequence_parallel):
 
 def _count(hlo, op):
     # ops appear as "all-gather(", "all-gather-start(", fusion names, etc.;
-    # count instruction definitions only
-    return len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
+    # count instruction definitions only.  The result type is either one
+    # token (f32[2,4]{...}) or a tuple "(f32[..], f32[..])" — tuple-typed
+    # collectives (e.g. the CPU backend's all-to-all) contain spaces, which
+    # a plain \S+ match would miss.
+    return len(re.findall(rf"= (?:\([^)]*\)|\S+) {op}(?:-start)?\(", hlo))
 
 
 def test_sp_collective_plan_is_exact(tp4_mesh):
@@ -99,6 +102,170 @@ def test_tp_collective_plan_without_sp(tp4_mesh):
     # of the column layer's input grad; no gather/scatter
     assert ar == 2, f"expected 2 all-reduces (fwd g + bwd f): {ar}"
     assert ag == 0 and rs == 0, (ag, rs)
+
+
+def test_1f1b_collective_plan_is_exact(devices):
+    """1F1B on pp=4: the compiled program's only collectives are the wire
+    transfers (one fwd send/recv pair site, one bwd — the schedule runs
+    under lax.scan, so the HLO instruction count is microbatch-independent)
+    plus ONE scalar all-reduce that returns the mean loss on every rank.
+    An XLA or schedule regression that syncs grads across stages (the bug
+    class this pins against: pp grads are per-stage, never all-reduced)
+    would show up as extra/bigger all-reduces.
+
+    Reference spec: fwd_bwd_pipelining_without_interleaving.py:241 region —
+    p2p send/recv only, no collective over the grads.
+    """
+    from apex_tpu.transformer.pipeline_parallel import (
+        PipelineStageSpec,
+        forward_backward_pipelining_1f1b,
+    )
+
+    mesh = parallel_state.initialize_model_parallel(1, 4, devices=devices[:4])
+    try:
+        def stage_fn(params, x):
+            return jax.nn.gelu(jnp.dot(x, params["w"]) + params["b"])
+
+        spec = PipelineStageSpec(
+            stage_fn=stage_fn,
+            first_fn=lambda params, mb: mb["x"],
+            last_fn=lambda params, y, mb: jnp.mean((y - mb["y"]) ** 2))
+        stacked = {"w": jnp.zeros((4, 8, 8), jnp.float32),
+                   "b": jnp.zeros((4, 8), jnp.float32)}
+        batches = {"x": jnp.zeros((4, 2, 8), jnp.float32),
+                   "y": jnp.zeros((4, 2, 8), jnp.float32)}
+
+        def run(stage_params, batches):
+            p = jax.tree.map(lambda l: l[0], stage_params)
+            loss, grads = forward_backward_pipelining_1f1b(spec, p, batches)
+            return loss, jax.tree.map(lambda l: l[None], grads)
+
+        fn = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=({"w": P("pp"), "b": P("pp")}, P()),
+            out_specs=(P(), {"w": P("pp"), "b": P("pp")}), check_vma=False))
+        hlo = fn.lower(stacked, batches).compile().as_text()
+    finally:
+        parallel_state.destroy_model_parallel()
+
+    cp = _count(hlo, "collective-permute")
+    ar = _count(hlo, "all-reduce")
+    assert cp == 2, f"expected 2 permute sites (fwd wire + bwd wire): {cp}"
+    assert ar == 1, f"expected exactly the loss all-reduce: {ar}"
+    # the single all-reduce must be the scalar loss, not a grad sync
+    # (same tuple-type-aware pattern as _count)
+    ar_lines = [ln for ln in hlo.splitlines()
+                if re.search(r"= (?:\([^)]*\)|\S+) all-reduce(?:-start)?\(",
+                             ln)]
+    assert len(ar_lines) == 1 and "f32[]" in ar_lines[0], ar_lines
+    assert _count(hlo, "all-gather") == 0
+    assert _count(hlo, "reduce-scatter") == 0
+
+
+def test_cp_ring_collective_plan_is_exact(devices):
+    """Ring attention fwd+bwd on cp=8: exactly 2 permute sites forward
+    (the k and v ring rotations, inside one lax.scan executing cp-1
+    steps — parity with the dense oracle in test_ring_attention.py proves
+    the trip count) and 2 in backward; NO all-gather — the whole point of
+    ring attention is that k/v are never materialized globally — and no
+    all-reduce.
+    """
+    from apex_tpu.transformer.context_parallel import ring_attention
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("cp",))
+    q = jnp.zeros((1, 2, 64, 8), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(
+            ring_attention(q, k, v, axis_name="cp", causal=True) ** 2)
+
+    def fn(q, k, v):
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    with mesh:
+        f = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(None, None, "cp"),) * 3,
+            out_specs=(P(None, None, "cp"),) * 3, check_vma=False))
+        hlo = f.lower(q, q, q).compile().as_text()
+
+    cp = _count(hlo, "collective-permute")
+    assert cp == 4, f"expected 4 permute sites (k+v rotations, fwd+bwd): {cp}"
+    assert _count(hlo, "all-gather") == 0, "ring must never gather k/v"
+    assert _count(hlo, "all-reduce") == 0
+    assert _count(hlo, "all-to-all") == 0
+
+
+def test_ep_collective_plan_is_exact(devices):
+    """Expert-parallel MoE fwd+bwd on ep=4: exactly 2 all-to-alls forward
+    (GShard dispatch + combine) and 2 backward (their transposes — an
+    all-to-all's cotangent is the reverse all-to-all), and no other
+    cross-rank collective: router/expert grads are local by construction.
+    """
+    from apex_tpu.transformer.moe import ExpertParallelMLP
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
+    tokens_per_rank, h = 16, 8
+    x = jnp.zeros((4 * tokens_per_rank, h), jnp.float32)
+    sharded = ExpertParallelMLP(num_experts=4, hidden_size=h,
+                                ffn_hidden_size=16, capacity_factor=4.0,
+                                axis_name="ep")
+    local = ExpertParallelMLP(num_experts=4, hidden_size=h,
+                              ffn_hidden_size=16, capacity_factor=4.0,
+                              axis_name=None)
+    full = local.init(jax.random.PRNGKey(0), x)
+    local_params = {"params": {
+        "router": full["params"]["router"],
+        "w_in": full["params"]["w_in"][:1],
+        "w_out": full["params"]["w_out"][:1]}}
+
+    def fn(x_shard, p):
+        def loss(p, x_shard):
+            out, _aux = sharded.apply(p, x_shard)
+            return jnp.sum(out ** 2)
+
+        return jax.grad(loss, argnums=(0, 1))(p, x_shard)
+
+    with mesh:
+        f = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("ep"), P()),
+                              out_specs=(P(), P("ep")), check_vma=False))
+        hlo = f.lower(x, local_params).compile().as_text()
+
+    a2a = _count(hlo, "all-to-all")
+    assert a2a == 4, f"expected 4 all-to-alls (dispatch+combine, fwd+bwd): {a2a}"
+    assert _count(hlo, "all-reduce") == 0
+    assert _count(hlo, "all-gather") == 0
+    assert _count(hlo, "reduce-scatter") == 0
+
+
+def test_zero2_collective_plan_is_exact(devices):
+    """ZeRO-2 step on dp=8: gradients reduce-scatter down to the owner
+    shard, updated params all-gather back — and critically NO all-reduce:
+    reduce-scatter + all-gather replacing all-reduce is the entire ZeRO
+    bandwidth story (reference distributed_fused_adam.py:273 region).
+    """
+    from apex_tpu.contrib.optimizers import DistributedFusedAdam
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    params = {"w": jnp.zeros((64, 9), jnp.float32),
+              "b": jnp.zeros((9,), jnp.float32)}
+    opt = DistributedFusedAdam(lr=1e-2)
+
+    def fn(params, grads):
+        state = opt.init(params)
+        new_params, _ = opt.step(grads, params, state)
+        return new_params
+
+    with mesh:
+        f = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P(), P()),
+                              out_specs=P(), check_vma=False))
+        hlo = f.lower(params, params).compile().as_text()
+
+    rs = _count(hlo, "reduce-scatter")
+    ag = _count(hlo, "all-gather")
+    ar = _count(hlo, "all-reduce")
+    assert rs == 1, f"expected 1 reduce-scatter of the flat grads: {rs}"
+    assert ag == 1, f"expected 1 all-gather of the updated flat params: {ag}"
+    assert ar == 0, f"ZeRO must not all-reduce, found {ar}"
 
 
 def test_wgrad_dots_present_and_fused(tp4_mesh):

@@ -80,8 +80,10 @@ def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
     """Materialized attention with flash-identical masking semantics.
 
     q: [b, h, sq, d]; k/v: [b, h, sk, d]; segment ids: [b, s].  Dropout
-    applies to the normalized probabilities (same semantics as the kernel,
-    though the keep mask comes from jax.random, not the kernel's hash)."""
+    applies to the normalized probabilities and draws the SAME counter
+    hash as the Pallas kernels — per (seed, coordinates) the two paths
+    realize bit-identical keep masks (pinned by
+    test_kernel_and_fallback_share_dropout_stream)."""
     d = q.shape[-1]
     scale = (1.0 / d ** 0.5) if scale is None else scale
     s = jax.lax.dot_general(
@@ -107,9 +109,16 @@ def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
         any_valid = jnp.any(valid, axis=-1, keepdims=True)
         p = jnp.where(any_valid, p, 0.0)
     if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(
-            jax.random.PRNGKey(jnp.asarray(dropout_seed, jnp.uint32)),
-            1.0 - dropout_rate, p.shape)
+        # the SAME counter hash as the Pallas kernels, evaluated densely:
+        # a shape-driven kernel/fallback routing change cannot silently
+        # change the dropout stream (r3 advisor finding), and parity tests
+        # compare realizations bit-for-bit
+        bb, hh, sq_, sk_ = p.shape
+        g = jnp.arange(bb * hh, dtype=jnp.uint32).reshape(bb, hh, 1, 1)
+        qpos = jnp.arange(sq_, dtype=jnp.uint32).reshape(1, 1, sq_, 1)
+        kpos = jnp.arange(sk_, dtype=jnp.uint32).reshape(1, 1, 1, sk_)
+        keep = _hash_keep(jnp.asarray(dropout_seed, jnp.uint32), g, qpos,
+                          kpos, dropout_rate)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jax.lax.dot_general(
         p, v.astype(jnp.float32), (((3,), (2,)), ((0, 1), (0, 1))))
@@ -121,28 +130,48 @@ def mha_reference(q, k, v, *, causal=False, q_segment_ids=None,
 # ---------------------------------------------------------------------------
 
 
-def _keep_mask(seed, g, i, j, bq, bk, rate):
-    """Counter-based dropout keep mask for tile (g, i, j): a murmur3-style
-    avalanche of (seed, batch-head, global q pos, global k pos).  Stateless,
-    so the forward and both backward kernels regenerate the identical mask
-    from the same coordinates (the Philox property the reference relies on).
-    """
-    qpos = (i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            ).astype(jnp.uint32)
-    kpos = (j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            ).astype(jnp.uint32)
-    h = (seed.astype(jnp.uint32)
-         ^ (qpos * jnp.uint32(0x9E3779B1))
-         ^ (kpos * jnp.uint32(0x85EBCA77))
-         ^ (g.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)))
+def _fmix32(h):
+    """murmur3's 32-bit finalizer: full avalanche (every input bit flips
+    each output bit with ~1/2 probability)."""
     h = h ^ (h >> 16)
     h = h * jnp.uint32(0x85EBCA6B)
     h = h ^ (h >> 13)
     h = h * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> 16)
+    return h
+
+
+def _hash_keep(seed, g, qpos, kpos, rate):
+    """Counter-based dropout keep decision for coordinates (g, qpos, kpos).
+
+    Each coordinate is folded through the full finalizer in sequence
+    (h = fmix(h ^ c)), not XOR-combined before one finalizer round: a
+    single shared round would give distinct (qpos, kpos, g) triples with
+    colliding pre-mix XORs identical keep bits — structured cross-position
+    correlation (r3 advisor finding).  Chaining makes each coordinate
+    avalanche independently, the property the reference gets from Philox
+    key/counter separation.  All operands broadcast, so the same function
+    serves the Pallas tiles and the dense jnp fallback — the two paths
+    are bit-identical per (seed, coordinates).
+    """
+    h = _fmix32(seed ^ qpos)
+    h = _fmix32(h ^ kpos)
+    h = _fmix32(h ^ g)
     # P(h < T) = rate for T = rate * 2^32 (h uniform over uint32)
     threshold = jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
     return h >= threshold
+
+
+def _keep_mask(seed, g, i, j, bq, bk, rate):
+    """Keep mask for tile (g, i, j).  Stateless, so the forward and both
+    backward kernels regenerate the identical mask from the same
+    coordinates (the Philox property the reference relies on)."""
+    qpos = (i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ).astype(jnp.uint32)
+    kpos = (j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            ).astype(jnp.uint32)
+    return _hash_keep(seed.astype(jnp.uint32), g.astype(jnp.uint32),
+                      qpos, kpos, rate)
 
 
 def _block_mask(i, j, bq, bk, sq, sk, causal, has_seg, qseg, kseg):

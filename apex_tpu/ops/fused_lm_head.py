@@ -49,14 +49,21 @@ _NEG_INF = -1e30
 
 
 def lm_head_loss_reference(hidden, embedding, labels):
-    """Materialized reference: logits = H Eᵀ (fp32), per-token CE loss."""
+    """Materialized reference: logits = H Eᵀ (fp32), per-token CE loss.
+
+    Out-of-range labels contribute a target logit of exactly 0 (loss =
+    lse), matching the kernel's no-column-matches behavior — NOT torch's
+    take-and-clamp.  See :func:`fused_lm_head_loss` for the contract.
+    """
     logits = jax.lax.dot_general(
         hidden, embedding, (((hidden.ndim - 1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     m = jnp.max(logits, axis=-1)
     lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1))
-    tgt = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-    return lse - tgt
+    valid = (labels >= 0) & (labels < embedding.shape[0])
+    safe = jnp.clip(labels, 0, embedding.shape[0] - 1)
+    tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    return lse - jnp.where(valid, tgt, 0.0)
 
 
 # ---------------------------------------------------------------------------
@@ -84,9 +91,12 @@ def _fwd_kernel(h_ref, e_ref, lab_ref, loss_ref, lse_ref, m_scr, l_scr,
     live = col < vocab                          # mask the padded vocab tail
     s = jnp.where(live, s, _NEG_INF)
 
-    # target logit: labels are lane-tiled [Tb, 128]; column 0 holds the id
+    # target logit: labels are lane-tiled [Tb, 128]; column 0 holds the id.
+    # The live guard keeps labels that land in the padded vocab tail (an
+    # out-of-range id) from accumulating the -1e30 mask value: such rows
+    # return lse - 0, identical to the materialized fallback.
     lab = lab_ref[...][:, :1]                   # [Tb, 1]
-    t_scr[...] += jnp.sum(jnp.where(col == lab, s, 0.0), axis=-1,
+    t_scr[...] += jnp.sum(jnp.where((col == lab) & live, s, 0.0), axis=-1,
                           keepdims=True)
 
     m_prev = m_scr[:, :1]
@@ -313,6 +323,12 @@ def fused_lm_head_loss(hidden, embedding, labels, *, block_t: int = 512,
       hidden: ``[..., h]`` activations (any leading shape; bf16/fp32).
       embedding: ``[vocab, h]`` tied LM-head table.
       labels: ``[...]`` int32 target ids (same leading shape as hidden).
+        **Must be in ``[0, vocab)``.**  Out-of-range ids (e.g. an
+        ignore_index like -100) are NOT supported: both paths then return
+        ``lse`` (target logit treated as 0) with a zero gradient to the
+        missing column — a deterministic, path-independent value, but not
+        a cross-entropy.  Mask ignored tokens explicitly instead:
+        ``jnp.where(labels == ignore, 0.0, loss)`` with safe labels.
       block_t / block_v: token / vocab tile sizes (vocab is padded to
         block_v internally; tokens must divide block_t for the kernel
         path, else the materialized reference runs).
@@ -325,6 +341,13 @@ def fused_lm_head_loss(hidden, embedding, labels, *, block_t: int = 512,
     h2 = hidden.reshape(-1, hid)
     lab = labels.reshape(-1).astype(jnp.int32)
     t = h2.shape[0]
+    # the fwd VMEM footprint is dominated by the double-buffered e tile
+    # (vb*hid) plus the fp32 score tile (tb*vb): the default 512x1536 fits
+    # at hid<=1280 but overflows the ~16 MiB scoped budget at hid=2048
+    # (measured: 17.25M requested compiling the 1.3B config) — shrink the
+    # vocab tile as hid grows past the tuned point
+    if hid > 1280:
+        block_v = min(block_v, max(128, (1536 * 1280 // hid) // 128 * 128))
     if _kernel_ok(t, hid, block_t):
         loss = _fused(h2, embedding, lab, min(block_t, t), block_v)
     else:

@@ -66,11 +66,14 @@ _CONFIGS = {
     "medium": dict(layers=24, hidden=1024, heads=16, vocab=50304,
                    seq=1024, batch=8, steps=8,
                    remat=None, state_dtype="float32"),
-    # 1.3B: bf16 moments (fused_lamb.py state_dtype) + per-layer remat;
-    # fp32 m+v alone would be 10.6 GB, activations-without-remat ~3 GB
+    # 1.3B: bf16 moments (fused_lamb.py state_dtype) + FULL per-layer
+    # recompute.  fp32 m+v alone would be 10.6 GB; the lighter
+    # 'except_activations' policy keeps every matmul output and measures
+    # 26 GB total at this scale (compile log, r4) — only whole-layer
+    # recompute (saved residual = one [s,b,h] per layer, 0.8 GB) fits
     "1.3b": dict(layers=24, hidden=2048, heads=32, vocab=50304,
                  seq=1024, batch=8, steps=4,
-                 remat="except_activations", state_dtype="bfloat16"),
+                 remat="full", state_dtype="bfloat16"),
     "cpu-smoke": dict(layers=2, hidden=128, heads=4, vocab=1024,
                       seq=128, batch=2, steps=2,
                       remat=None, state_dtype="float32"),
@@ -95,7 +98,7 @@ def _peak_tflops(device) -> float:
 
 
 def run_config(name: str, *, batch: int | None = None,
-               steps: int | None = None) -> dict:
+               steps: int | None = None, seq: int | None = None) -> dict:
     """Build everything from scratch, run the timing protocol, return the
     result dict.  Raises on any failure — the caller owns retry policy."""
     from apex_tpu.optimizers import FusedLAMB
@@ -106,18 +109,23 @@ def run_config(name: str, *, batch: int | None = None,
         cfg["batch"] = batch
     if steps:
         cfg["steps"] = steps
+    if seq:
+        cfg["seq"] = seq
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     n_chips = jax.device_count()
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
 
+    # remat: None = no recompute; "full" = whole-layer recompute (policy
+    # None under activations_checkpoint); else a named jax checkpoint policy
     model = GPTModel(
         num_layers=cfg["layers"], hidden_size=cfg["hidden"],
         num_attention_heads=cfg["heads"], vocab_size=cfg["vocab"],
         max_sequence_length=cfg["seq"], params_dtype=jnp.float32,
         activations_checkpoint=bool(cfg["remat"]),
-        activations_checkpoint_policy=cfg["remat"])
+        activations_checkpoint_policy=(
+            None if cfg["remat"] in (None, "full") else cfg["remat"]))
     opt = FusedLAMB(lr=1e-3, state_dtype=jnp.dtype(cfg["state_dtype"]))
 
     rng = np.random.default_rng(0)
@@ -125,12 +133,20 @@ def run_config(name: str, *, batch: int | None = None,
                       jnp.int32)
     labels = jnp.roll(ids, -1, axis=1)
 
-    params = model.init(jax.random.PRNGKey(0), ids)
-    # O2-style: bf16 weights for matmuls, fp32 master state inside the
-    # optimizer (FusedLAMB keeps fp32 m/v; layernorm params stay fp32)
-    params = jax.tree.map(lambda p: p.astype(dtype) if p.dtype == jnp.float32
-                          and p.ndim >= 2 else p, params)
-    opt_state = opt.init(params)
+    # init + O2 cast (bf16 weights for matmuls, fp32 master state inside
+    # the optimizer; layernorm params stay fp32) + opt state, in ONE jitted
+    # program: eagerly the fp32 init, bf16 copies and zero moments coexist
+    # as separate allocations — at 1.3B that transient alone approaches the
+    # HBM limit before the step ever runs
+    @jax.jit
+    def init_all(ids):
+        params = model.init(jax.random.PRNGKey(0), ids)
+        params = jax.tree.map(
+            lambda p: p.astype(dtype)
+            if p.dtype == jnp.float32 and p.ndim >= 2 else p, params)
+        return params, opt.init(params)
+
+    params, opt_state = init_all(ids)
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, ids, labels):
@@ -271,7 +287,7 @@ def main(model: str | None, batch: int | None, steps: int | None,
     sys.exit(1)
 
 
-def tp_dryrun(tp: int) -> None:
+def tp_dryrun(tp: int) -> dict:
     """Multi-chip bench readiness (VERDICT r2 item 5): compile the FULL
     GPT-1.3B TP=``tp`` training step (sequence parallelism, flash attention,
     FusedLAMB, donated buffers) at real shapes, and emit the projected
@@ -292,9 +308,14 @@ def tp_dryrun(tp: int) -> None:
             f"{flags} --xla_force_host_platform_device_count={tp}").strip()
         code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
                 f"import bench; bench.tp_dryrun({tp})")
-        subprocess.run([sys.executable, "-c", code], env=env, check=True,
-                       cwd=os.path.dirname(os.path.abspath(__file__)))
-        return
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+        sys.stderr.write(proc.stderr)
+        print(proc.stdout, end="")
+        if proc.returncode:  # diagnostics above, THEN fail
+            raise subprocess.CalledProcessError(proc.returncode, proc.args)
+        return json.loads(proc.stdout.strip().splitlines()[-1])
 
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
@@ -313,7 +334,7 @@ def tp_dryrun(tp: int) -> None:
     model = GPTModel(num_layers=num_layers, hidden_size=hidden,
                      num_attention_heads=heads, vocab_size=vocab,
                      max_sequence_length=seq, params_dtype=jnp.float32,
-                     sequence_parallel_enabled=True, axis_name="tp",
+                     sequence_parallel_enabled=(tp > 1), axis_name="tp",
                      activations_checkpoint=True)
     opt = FusedLAMB(lr=1e-3)
 
@@ -388,10 +409,11 @@ def tp_dryrun(tp: int) -> None:
         },
         "config": {"layers": num_layers, "hidden": hidden, "heads": heads,
                    "vocab": vocab, "seq": seq, "batch": batch, "tp": tp,
-                   "sequence_parallel": True, "optimizer": "FusedLAMB"},
+                   "sequence_parallel": tp > 1, "optimizer": "FusedLAMB"},
     }
     parallel_state.destroy_model_parallel()
     print(json.dumps(result))
+    return result
 
 
 if __name__ == "__main__":

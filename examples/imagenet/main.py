@@ -10,15 +10,18 @@ Demonstrates the Phase-3 slice (SURVEY.md §7): ResNet-50 with
   replacing the reference's DDP bucket machinery),
 - optional dynamic loss scaling for fp16 parity.
 
-Trains on synthetic data, so it works anywhere:
+Trains on synthetic data by default, so it works anywhere:
 single TPU chip, TPU pod slice, or the 8-virtual-device CPU mesh used by the
-test-suite.  The reference's ``--prof`` NVTX window maps to
-``jax.profiler.trace``.
+test-suite.  ``--data-dir`` switches to a real ImageFolder tree with
+host-thread decode/augment overlapped against the async device step
+(``examples/imagenet/data.py``, main_amp.py:95-123 parity).  The
+reference's ``--prof`` NVTX window maps to ``jax.profiler.trace``.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import functools
 import os
 import time
@@ -137,17 +140,37 @@ def load_checkpoint(path, template):
 def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
                  image_size=224, num_classes=1000, steps=20, lr=0.1,
                  loss_scale=None, save=None, save_interval=None, resume=None,
-                 prof=False, seed=0, verbose=True):
-    """Train on synthetic data; returns the per-step loss trace + throughput.
+                 prof=False, seed=0, verbose=True, data_dir=None):
+    """Train on synthetic data (or a real image tree via ``data_dir``);
+    returns the per-step loss trace + throughput.
 
     Programmatic form of the reference CLI so the L1 convergence harness
     (tests/L1/common/run_test.sh:19-40) can sweep opt_level × loss_scale
     and diff the traces.
+
+    ``data_dir`` points at an ImageFolder tree (``class_x/img.jpeg``,
+    main_amp.py:95-123); decode/augment runs on host threads overlapped
+    with the async device step (examples/imagenet/data.py).
+    ``num_classes`` is then taken from the directory tree.
     """
     devices = jax.devices()
     mesh = Mesh(np.array(devices), ("dp",))
     if verbose:
         print(f"devices: {len(devices)} × {devices[0].platform}")
+
+    loader = None
+    if data_dir is not None:
+        import sys
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from data import ImageFolder, PrefetchLoader, batch_iterator
+
+        dataset = ImageFolder(data_dir)
+        num_classes = len(dataset.classes)
+        loader = PrefetchLoader(batch_iterator(
+            dataset, batch_size, image_size, train=True, seed=seed))
+        if verbose:
+            print(f"data: {len(dataset)} images, {num_classes} classes "
+                  f"from {data_dir}")
 
     half_dtype = jnp.bfloat16 if half == "bf16" else jnp.float16
     overrides = {} if loss_scale is None else {"loss_scale": loss_scale}
@@ -201,14 +224,21 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
         return (new_params, upd["batch_stats"], new_opt, new_scaler, loss,
                 found_inf)
 
-    key = np.random.default_rng(seed)
-    images = jnp.asarray(key.standard_normal(
-        (batch_size, image_size, image_size, 3)), jnp.float32)
-    labels = jnp.asarray(key.integers(0, num_classes, batch_size), jnp.int32)
-    images, labels = ddp.shard_batch((images, labels))
+    if loader is None:  # fixed synthetic batch (real data overwrites it)
+        key = np.random.default_rng(seed)
+        images = jnp.asarray(key.standard_normal(
+            (batch_size, image_size, image_size, 3)), jnp.float32)
+        labels = jnp.asarray(key.integers(0, num_classes, batch_size),
+                             jnp.int32)
+        images, labels = ddp.shard_batch((images, labels))
 
     losses = []
-    with mesh:
+    # ExitStack closes the prefetch thread even when the loop raises
+    # (run_training is called programmatically by the L1 sweep harness —
+    # leaked workers would accumulate across runs)
+    with mesh, contextlib.ExitStack() as _stack:
+        if loader is not None:
+            _stack.callback(loader.close)
         t0 = None
         found_inf = False
         tracing = False
@@ -216,6 +246,12 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
             if prof and step == 5:
                 jax.profiler.start_trace("/tmp/apex_tpu_trace")
                 tracing = True
+            if loader is not None:
+                # host decode of the NEXT batches continues in the
+                # prefetch thread while this step runs asynchronously
+                imgs_np, labels_np = next(loader)
+                images, labels = ddp.shard_batch(
+                    (jnp.asarray(imgs_np), jnp.asarray(labels_np)))
             params, batch_stats, opt_state, scaler_state, loss, found_inf = \
                 train_step(params, batch_stats, opt_state, scaler_state,
                            images, labels)
@@ -271,8 +307,9 @@ def main():
     ap.add_argument("--resume", default=None,
                     help="checkpoint directory to resume from "
                          "(main_amp.py:177-193)")
-    # This example trains on synthetic data only (the reference's main_amp.py
-    # folder-loading belongs to a data-pipeline library, out of scope here).
+    ap.add_argument("--data-dir", default=None,
+                    help="ImageFolder tree (class_x/img.jpeg) of real "
+                         "images (main_amp.py:95-123); default: synthetic")
     ap.add_argument("--prof", action="store_true",
                     help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
     args = ap.parse_args()
@@ -284,7 +321,7 @@ def main():
                  num_classes=args.num_classes, steps=args.steps, lr=args.lr,
                  loss_scale=loss_scale, save=args.save,
                  save_interval=args.save_interval, resume=args.resume,
-                 prof=args.prof)
+                 prof=args.prof, data_dir=args.data_dir)
 
 
 if __name__ == "__main__":

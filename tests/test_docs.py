@@ -1,0 +1,42 @@
+"""The API reference (VERDICT r3 item 7) must exist and cover the key
+packages — the markdown analog of the reference's sphinx tree building
+cleanly (`/root/reference/docs/source/index.rst` coverage)."""
+
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+DOCS = REPO / "docs"
+
+PAGES = ["amp", "optimizers", "parallel", "transformer", "normalization",
+         "layers", "ops", "models", "contrib", "utils"]
+
+# page -> symbols a user would look up there (spot checks that the
+# generator actually rendered the module contents, not empty shells)
+MUST_MENTION = {
+    "amp": ["initialize", "LossScaler"],
+    "optimizers": ["FusedAdam", "FusedLAMB", "DistributedFusedAdam"],
+    "parallel": ["DistributedDataParallel", "SyncBatchNorm", "LARC"],
+    "transformer": ["ColumnParallelLinear", "vocab_parallel_cross_entropy",
+                    "ring_attention", "ExpertParallelMLP"],
+    "normalization": ["FusedLayerNorm", "FusedRMSNorm"],
+    "ops": ["flash_attention", "fused_lm_head_loss"],
+    "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline"],
+    "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
+}
+
+
+def test_index_exists_and_links_all_pages():
+    index = (DOCS / "index.md").read_text()
+    for page in PAGES:
+        assert f"api/{page}.md" in index, f"index.md missing link to {page}"
+
+
+def test_pages_exist_and_cover_key_symbols():
+    for page in PAGES:
+        path = DOCS / "api" / f"{page}.md"
+        assert path.exists(), f"missing docs/api/{page}.md"
+        text = path.read_text()
+        assert len(text) > 500, f"{page}.md suspiciously small"
+        assert "IMPORT FAILED" not in text, f"{page}.md has import failures"
+        for sym in MUST_MENTION.get(page, []):
+            assert sym in text, f"{page}.md does not document {sym}"

@@ -52,6 +52,31 @@ def test_llama_pretrain_tp_dp():
     assert "llama pretrain OK: dp=4 tp=2" in out.stdout
 
 
+def _make_fake_imagefolder(root, classes=3, per_class=6, size=40):
+    from PIL import Image
+    rng = __import__("numpy").random.default_rng(0)
+    for c in range(classes):
+        d = root / f"class_{c}"
+        d.mkdir(parents=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (size, size, 3), dtype="uint8")
+            Image.fromarray(arr).save(d / f"img_{i}.jpg")
+
+
+def test_imagenet_real_data_path(tmp_path):
+    """--data-dir trains on a real image tree (VERDICT r3 item 8): PIL
+    decode + augment + prefetch feeding the amp/DDP/FusedSGD step."""
+    _make_fake_imagefolder(tmp_path / "train")
+    out = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "imagenet" / "main.py"),
+         "--arch", "resnet10", "--image-size", "32", "--batch-size", "8",
+         "--steps", "6", "--data-dir", str(tmp_path / "train")],
+        capture_output=True, text=True, timeout=600, env=ENV)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "data: 18 images, 3 classes" in out.stdout
+    assert "OK" in out.stdout
+
+
 def test_llama_pretrain_3d_tp_pp_dp():
     """BASELINE.md row 5 component set: Llama over dp x pp x tp with the
     1F1B schedule (VERDICT r3 item 5)."""

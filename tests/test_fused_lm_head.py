@@ -64,6 +64,31 @@ def test_leading_shape_and_fallback(rng):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_out_of_range_labels_are_path_independent(rng):
+    """Labels outside [0, vocab) (e.g. ignore_index -100) are unsupported
+    but must be DETERMINISTIC and path-independent (r3 advisor finding):
+    kernel and materialized fallback both return lse (target logit 0), so
+    shape-driven routing cannot flip the value silently."""
+    T, H, V = 256, 128, 512
+    h = jnp.asarray(rng.standard_normal((T, H)) * 0.5, jnp.float32)
+    e = jnp.asarray(rng.standard_normal((V, H)) * 0.5, jnp.float32)
+    lab = np.asarray(rng.integers(0, V, (T,)), np.int32)
+    lab[::7] = -100          # torch-style ignore_index
+    lab[3::11] = V + 5       # past the (padded) vocab
+    lab = jnp.asarray(lab)
+
+    kernel = fused_lm_head_loss(h, e, lab, block_t=128, block_v=384)
+    ref = lm_head_loss_reference(h, e, lab)
+    np.testing.assert_allclose(np.asarray(kernel), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # out-of-range rows are exactly lse (target contribution 0) — bigger
+    # than any real CE row's target term would allow on average
+    logits = np.asarray(h, np.float64) @ np.asarray(e, np.float64).T
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) \
+        + logits.max(-1)
+    np.testing.assert_allclose(np.asarray(kernel)[::7], lse[::7], rtol=1e-4)
+
+
 def test_gpt_model_routes_through_fused_head(rng):
     """GPTModel(tp world 1) training loss must equal the materialized
     vocab-parallel CE it replaces, through the whole model."""

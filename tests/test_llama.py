@@ -105,6 +105,49 @@ def test_loss_matches_logits_ce(rng):
                                atol=1e-4)
 
 
+def test_tied_embeddings_parity_and_grads(rng):
+    """tie_word_embeddings=True (r3 advisor finding: untested branch).
+
+    (a) logits equal an untied model whose lm_head was set to the
+    embedding table; (b) the embedding gradient is the SUM of the untied
+    model's embedding and head gradients — proving gradient flows through
+    BOTH uses of the shared table (the self.variables head read is not a
+    stop_gradient)."""
+    import dataclasses
+
+    tied_cfg = dataclasses.replace(CFG, tie_word_embeddings=True)
+    tied = LlamaForCausalLM(tied_cfg)
+    untied = LlamaForCausalLM(CFG)
+
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 16)), jnp.int32)
+    labels = jnp.roll(ids, -1, axis=1)
+    tied_params = tied.init(jax.random.PRNGKey(0), ids)
+    assert "lm_head" not in tied_params["params"], "tied model grew a head"
+
+    untied_params = jax.tree.map(lambda x: x, untied.init(
+        jax.random.PRNGKey(0), ids))
+    # same weights everywhere; head := embedding table
+    emb = tied_params["params"]["embed_tokens"]["embedding"]
+    for key in tied_params["params"]:
+        untied_params["params"][key] = tied_params["params"][key]
+    untied_params["params"]["lm_head"] = emb
+
+    out_tied = tied.apply(tied_params, ids)
+    out_untied = untied.apply(untied_params, ids)
+    np.testing.assert_allclose(np.asarray(out_tied), np.asarray(out_untied),
+                               rtol=1e-5, atol=1e-5)
+
+    g_tied = jax.grad(lambda p: tied.apply(p, ids, labels=labels).mean())(
+        tied_params)
+    g_untied = jax.grad(
+        lambda p: untied.apply(p, ids, labels=labels).mean())(untied_params)
+    g_emb_tied = np.asarray(g_tied["params"]["embed_tokens"]["embedding"])
+    g_sum = (np.asarray(g_untied["params"]["embed_tokens"]["embedding"])
+             + np.asarray(g_untied["params"]["lm_head"]))
+    assert np.abs(g_emb_tied).max() > 0, "no gradient reached the embedding"
+    np.testing.assert_allclose(g_emb_tied, g_sum, rtol=1e-4, atol=1e-6)
+
+
 def test_gqa_heads_shape():
     """kv_heads < heads runs the broadcast path and matches an MHA model
     in which the kv heads are explicitly repeated."""

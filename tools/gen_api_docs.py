@@ -1,0 +1,231 @@
+"""Generate the markdown API reference (docs/api/*.md) from the package.
+
+Mirrors the coverage of the reference's sphinx tree
+(``/root/reference/docs/source/index.rst``: amp, parallel, optimizers,
+layernorm, fp16_utils) and extends it to every public apex_tpu package.
+Signatures and docstrings are introspected from the live modules, so the
+docs cannot drift from the code: re-run this after API changes.
+
+    python tools/gen_api_docs.py [--check]
+
+``--check`` exits 1 if the generated tree differs from what is on disk
+(tests/test_docs.py runs a light version of this).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "docs", "api")
+
+# page -> (title, [module, ...]) — grouped like the reference's toctree
+PAGES = {
+    "amp": ("Mixed precision (amp)", [
+        "apex_tpu.amp", "apex_tpu.amp.policy", "apex_tpu.amp.scaler",
+        "apex_tpu.amp.lists", "apex_tpu.amp.functional",
+        "apex_tpu.fp16_utils",
+    ]),
+    "optimizers": ("Fused optimizers", [
+        "apex_tpu.optimizers", "apex_tpu.optimizers._common",
+        "apex_tpu.contrib.optimizers",
+        "apex_tpu.multi_tensor_apply",
+    ]),
+    "parallel": ("Data / model parallelism", [
+        "apex_tpu.parallel", "apex_tpu.parallel.LARC",
+        "apex_tpu.transformer.parallel_state",
+    ]),
+    "transformer": ("Transformer toolbox (tp / pp / sp / ep / cp)", [
+        "apex_tpu.transformer.tensor_parallel",
+        "apex_tpu.transformer.pipeline_parallel",
+        "apex_tpu.transformer.moe",
+        "apex_tpu.transformer.context_parallel",
+        "apex_tpu.transformer.layers",
+        "apex_tpu.transformer.functional",
+        "apex_tpu.transformer.amp",
+        "apex_tpu.transformer.testing",
+    ]),
+    "normalization": ("Normalization layers", [
+        "apex_tpu.normalization",
+    ]),
+    "layers": ("Fused dense / MLP / RNN", [
+        "apex_tpu.fused_dense", "apex_tpu.mlp", "apex_tpu.RNN",
+    ]),
+    "ops": ("Pallas kernels (ops)", [
+        "apex_tpu.ops.flash_attention", "apex_tpu.ops.softmax",
+        "apex_tpu.ops.rope", "apex_tpu.ops.layer_norm",
+        "apex_tpu.ops.packed_update", "apex_tpu.ops.fused_lm_head",
+        "apex_tpu.ops.pair_bias_attention",
+    ]),
+    "models": ("Model zoo", [
+        "apex_tpu.models", "apex_tpu.models.llama",
+        "apex_tpu.models.llama_pipeline", "apex_tpu.models.vit",
+    ]),
+    "contrib": ("Contrib extensions", [
+        "apex_tpu.contrib.xentropy", "apex_tpu.contrib.focal_loss",
+        "apex_tpu.contrib.group_norm", "apex_tpu.contrib.groupbn",
+        "apex_tpu.contrib.cudnn_gbn", "apex_tpu.contrib.index_mul_2d",
+        "apex_tpu.contrib.fmha", "apex_tpu.contrib.multihead_attn",
+        "apex_tpu.contrib.transducer", "apex_tpu.contrib.halo",
+        "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.sparsity",
+        "apex_tpu.contrib.clip_grad", "apex_tpu.contrib.openfold_triton",
+    ]),
+    "utils": ("Utilities", [
+        "apex_tpu.utils.nvtx", "apex_tpu.utils.packing",
+        "apex_tpu.feature_registry", "apex_tpu._logging",
+    ]),
+}
+
+
+def _doc_first_block(obj) -> str:
+    if inspect.isclass(obj) and vars(obj).get("__doc__") is None:
+        # no own docstring: inspect.getdoc would return the (misleading)
+        # inherited base-class doc — use the defining module's instead
+        try:
+            mod = importlib.import_module(obj.__module__)
+            doc = (mod.__doc__ or "").split("\n\n")[0].strip()
+            return doc
+        except Exception:
+            return ""
+    doc = inspect.getdoc(obj) or ""
+    block = doc.split("\n\n")[0].strip()
+    return block
+
+
+def _sig(obj) -> str:
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def _public_names(mod):
+    if hasattr(mod, "__all__"):
+        return list(mod.__all__)
+    return [n for n, o in vars(mod).items()
+            if not n.startswith("_")
+            and getattr(o, "__module__", None) == mod.__name__
+            and (inspect.isclass(o) or inspect.isfunction(o))]
+
+
+def _render_symbol(name: str, obj) -> list[str]:
+    lines = []
+    if inspect.isclass(obj):
+        lines.append(f"### class `{name}{_sig(obj)}`\n")
+        d = _doc_first_block(obj)
+        if d:
+            lines.append(d + "\n")
+        # public methods defined on the class itself
+        for mname, m in sorted(vars(obj).items()):
+            if mname.startswith("_") or not callable(m):
+                continue
+            try:
+                func = m.__func__ if isinstance(m, (classmethod,
+                                                    staticmethod)) else m
+                lines.append(f"- **`.{mname}{_sig(func)}`** — "
+                             f"{_doc_first_block(func) or '(no doc)'}")
+            except Exception:
+                continue
+        if lines and lines[-1].startswith("- "):
+            lines.append("")
+    elif callable(obj):
+        lines.append(f"### `{name}{_sig(obj)}`\n")
+        d = _doc_first_block(obj)
+        if d:
+            lines.append(d + "\n")
+    else:  # data export (e.g. enum instance, constant)
+        lines.append(f"### `{name}` = `{obj!r}`\n")
+    return lines
+
+
+def render_page(key: str) -> str:
+    title, modules = PAGES[key]
+    out = [f"# {title}\n"]
+    for modname in modules:
+        try:
+            mod = importlib.import_module(modname)
+        except Exception as e:  # pragma: no cover - import errors are bugs
+            out.append(f"## `{modname}` — IMPORT FAILED: {e}\n")
+            continue
+        out.append(f"## `{modname}`\n")
+        d = _doc_first_block(mod)
+        if d:
+            out.append(d + "\n")
+        for name in _public_names(mod):
+            obj = getattr(mod, name, None)
+            if obj is None:
+                continue
+            # skip re-exports documented under their home module's page
+            home = getattr(obj, "__module__", modname)
+            if (home != modname and home in sum(
+                    (m for _, m in PAGES.values()), [])
+                    and modname.count(".") >= 2):
+                continue
+            out.extend(_render_symbol(name, obj))
+    return "\n".join(out) + "\n"
+
+
+def render_index() -> str:
+    lines = [
+        "# apex_tpu API reference\n",
+        "TPU-native counterpart of the reference's sphinx tree "
+        "(`docs/source/index.rst`: amp, parallel, optimizers, layernorm, "
+        "fp16_utils), extended to every public package.  Generated from "
+        "the live modules by `tools/gen_api_docs.py` — signatures cannot "
+        "drift from the code.\n",
+        "| Page | Covers |",
+        "|---|---|",
+    ]
+    for key, (title, modules) in PAGES.items():
+        mods = ", ".join(f"`{m.removeprefix('apex_tpu.')}`" for m in modules)
+        lines.append(f"| [{title}](api/{key}.md) | {mods} |")
+    lines.append(
+        "\nSee also: [README](../README.md) (quickstart + design map), "
+        "[PARITY.md](../PARITY.md) (component-by-component reference "
+        "parity), [PERF_NOTES.md](../PERF_NOTES.md) (measured performance "
+        "log), [BASELINE.md](../BASELINE.md) (targets and captured "
+        "numbers).\n")
+    return "\n".join(lines)
+
+
+def generate() -> dict[str, str]:
+    files = {os.path.join(REPO, "docs", "index.md"): render_index()}
+    for key in PAGES:
+        files[os.path.join(OUT, f"{key}.md")] = render_page(key)
+    return files
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if docs on disk are stale")
+    args = ap.parse_args()
+
+    files = generate()
+    stale = []
+    for path, content in files.items():
+        on_disk = ""
+        if os.path.exists(path):
+            with open(path) as f:
+                on_disk = f.read()
+        if on_disk != content:
+            stale.append(os.path.relpath(path, REPO))
+            if not args.check:
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as f:
+                    f.write(content)
+    if args.check and stale:
+        print("stale docs (re-run tools/gen_api_docs.py):", *stale, sep="\n  ")
+        sys.exit(1)
+    print(f"{'checked' if args.check else 'wrote'} {len(files)} pages"
+          + (f" ({len(stale)} updated)" if not args.check else ""))
+
+
+if __name__ == "__main__":
+    main()

@@ -5,9 +5,10 @@ Prints ONE JSON line:
 
 The flagship config is a GPT-2-large (774M) causal LM trained with the
 full apex_tpu stack (flash attention, fused LN kernels, fused LM-head CE
-kernel, FusedLAMB — the BASELINE.md north-star optimizer, bf16 O2 policy,
-donated buffers).  ``--model 1.3b`` runs a GPT 1.3B on the same single
-chip (activation recompute + bf16 LAMB moments to fit 16 GB HBM).
+kernel, FusedLAMB with bf16 moments — the BASELINE.md north-star
+optimizer, bf16 O2 policy, donated buffers) — r4 measured 0.483 MFU.
+``--model 1.3b`` runs a GPT 1.3B on the same single chip (activation
+recompute + bf16 LAMB moments to fit 16 GB HBM) at 0.451 MFU.
 
 ``vs_baseline`` is measured MFU / 0.45 (the BASELINE.md target), so 1.0
 means the target is met.  This definition is fixed as of r3 (r2 used a
@@ -58,10 +59,15 @@ _PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0,
 # Model cards.  remat/state_dtype are the memory levers that let each
 # config fit one 16 GB v5e chip (PERF_NOTES.md has the accounting).
 _CONFIGS = {
-    # 774M: fits with full fp32 LAMB state and NO activation recompute
+    # 774M flagship: NO activation recompute; bf16 LAMB moments (the r4
+    # HBM-traffic lever: fp32 state measures 456 ms/step = 0.449 MFU,
+    # bf16 moments 424.5 ms = 0.483 — the 32 ms is exactly the halved m/v
+    # read+write traffic; trajectory parity pinned in test_optimizers).
+    # batch 12 regresses (0.459, memory pressure) and batch 16 does not
+    # fit even with except_activations remat — measured r4, PERF_NOTES.md
     "large": dict(layers=36, hidden=1280, heads=20, vocab=50304,
                   seq=1024, batch=8, steps=8,
-                  remat=None, state_dtype="float32"),
+                  remat=None, state_dtype="bfloat16"),
     # 355M: the r2 flagship, kept as the fallback config
     "medium": dict(layers=24, hidden=1024, heads=16, vocab=50304,
                    seq=1024, batch=8, steps=8,
@@ -287,12 +293,16 @@ def main(model: str | None, batch: int | None, steps: int | None,
     sys.exit(1)
 
 
-def tp_dryrun(tp: int) -> dict:
+def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
     """Multi-chip bench readiness (VERDICT r2 item 5): compile the FULL
-    GPT-1.3B TP=``tp`` training step (sequence parallelism, flash attention,
-    FusedLAMB, donated buffers) at real shapes, and emit the projected
+    TP=``tp`` training step (sequence parallelism, flash attention, fused
+    optimizer, donated buffers) at real shapes, and emit the projected
     per-chip memory plus the pinned HLO collective plan — so the flagship
     config runs the day real multi-chip hardware exists.
+
+    ``model_name``: ``gpt-1.3b`` (FusedLAMB — the BASELINE GPT row) or
+    ``llama7b`` (Llama-2 7B, FusedAdam — BASELINE row 5's "TP x PP,
+    multi-tensor Adam" component set, here at TP=tp with remat).
 
     Compile-only (AOT via ShapeDtypeStructs): nothing is materialized, so
     this runs on the 8-virtual-CPU-device mesh.  Per-chip numbers are
@@ -307,7 +317,7 @@ def tp_dryrun(tp: int) -> dict:
         env["XLA_FLAGS"] = (
             f"{flags} --xla_force_host_platform_device_count={tp}").strip()
         code = (f"import jax; jax.config.update('jax_platforms', 'cpu'); "
-                f"import bench; bench.tp_dryrun({tp})")
+                f"import bench; bench.tp_dryrun({tp}, {model_name!r})")
         proc = subprocess.run([sys.executable, "-c", code], env=env,
                               capture_output=True, text=True,
                               cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -320,23 +330,38 @@ def tp_dryrun(tp: int) -> dict:
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from apex_tpu.optimizers import FusedLAMB
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
     from apex_tpu.transformer import parallel_state
     from apex_tpu.transformer.testing import GPTModel
 
-    # GPT-2 1.3B (BASELINE.md north-star row): 24 x 2048, 32 heads
-    num_layers, hidden, heads, vocab, seq, batch = 24, 2048, 32, 50304, 1024, 8
     mesh = parallel_state.initialize_model_parallel(
         tp, 1, devices=jax.devices()[:tp])
-    # activation checkpointing is part of the flagship config: without it the
-    # compiled per-chip temp is ~17 GB (> v5e HBM) at batch 8 — measured by
-    # this very dryrun with activations_checkpoint=False
-    model = GPTModel(num_layers=num_layers, hidden_size=hidden,
-                     num_attention_heads=heads, vocab_size=vocab,
-                     max_sequence_length=seq, params_dtype=jnp.float32,
-                     sequence_parallel_enabled=(tp > 1), axis_name="tp",
-                     activations_checkpoint=True)
-    opt = FusedLAMB(lr=1e-3)
+    if model_name == "llama7b":
+        from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        # Llama-2 7B at its real architecture (BASELINE row 5)
+        lcfg = LlamaConfig.llama2_7b()
+        num_layers, hidden, heads = (lcfg.num_hidden_layers,
+                                     lcfg.hidden_size,
+                                     lcfg.num_attention_heads)
+        vocab, seq, batch = lcfg.vocab_size, 4096, 4
+        model = LlamaForCausalLM(
+            lcfg, sequence_parallel_enabled=(tp > 1), axis_name="tp",
+            activations_checkpoint=True)
+        opt = FusedAdam(lr=1e-3)  # row 5: multi-tensor Adam
+    else:
+        # GPT-2 1.3B (BASELINE.md north-star row): 24 x 2048, 32 heads
+        num_layers, hidden, heads, vocab, seq, batch = (24, 2048, 32,
+                                                        50304, 1024, 8)
+        # activation checkpointing is part of the flagship config: without
+        # it the compiled per-chip temp is ~17 GB (> v5e HBM) at batch 8 —
+        # measured by this very dryrun with activations_checkpoint=False
+        model = GPTModel(num_layers=num_layers, hidden_size=hidden,
+                         num_attention_heads=heads, vocab_size=vocab,
+                         max_sequence_length=seq, params_dtype=jnp.float32,
+                         sequence_parallel_enabled=(tp > 1), axis_name="tp",
+                         activations_checkpoint=True)
+        opt = FusedLAMB(lr=1e-3)
 
     ids_s = jax.ShapeDtypeStruct((batch, seq), jnp.int32)
 
@@ -370,9 +395,13 @@ def tp_dryrun(tp: int) -> dict:
         return len(re.findall(rf"= \S+ {op}(?:-start)?\(", hlo))
 
     # global param count from an unmapped abstract init (axis world = 1)
-    global_model = GPTModel(
-        num_layers=num_layers, hidden_size=hidden, num_attention_heads=heads,
-        vocab_size=vocab, max_sequence_length=seq, params_dtype=jnp.float32)
+    if model_name == "llama7b":
+        global_model = LlamaForCausalLM(lcfg)
+    else:
+        global_model = GPTModel(
+            num_layers=num_layers, hidden_size=hidden,
+            num_attention_heads=heads, vocab_size=vocab,
+            max_sequence_length=seq, params_dtype=jnp.float32)
     gshapes = jax.eval_shape(
         lambda: global_model.init(jax.random.PRNGKey(0),
                                   jnp.zeros((1, seq), jnp.int32)))
@@ -381,10 +410,11 @@ def tp_dryrun(tp: int) -> dict:
     # donated params/opt_state alias their outputs — don't count them twice
     per_chip = (mem.argument_size_in_bytes + mem.temp_size_in_bytes
                 + mem.output_size_in_bytes - mem.alias_size_in_bytes)
-    # per-chip steady state: bf16 shard of params + fp32 LAMB m/v shard
+    # per-chip steady state: bf16 shard of params + fp32 m/v shard
     analytic_gb = (n_params * 2 + n_params * 4 * 2) / tp / 2**30
+    metric_model = "llama2_7b" if model_name == "llama7b" else "gpt2_1p3b"
     result = {
-        "metric": f"gpt2_1p3b_tp{tp}_dryrun",
+        "metric": f"{metric_model}_tp{tp}_dryrun",
         "ok": True,
         "params_b": round(n_params / 1e9, 3),
         "params_per_shard_b": round(n_shard / 1e9, 3),
@@ -409,7 +439,9 @@ def tp_dryrun(tp: int) -> dict:
         },
         "config": {"layers": num_layers, "hidden": hidden, "heads": heads,
                    "vocab": vocab, "seq": seq, "batch": batch, "tp": tp,
-                   "sequence_parallel": tp > 1, "optimizer": "FusedLAMB"},
+                   "sequence_parallel": tp > 1,
+                   "optimizer": ("FusedAdam" if model_name == "llama7b"
+                                 else "FusedLAMB")},
     }
     parallel_state.destroy_model_parallel()
     print(json.dumps(result))
@@ -418,9 +450,11 @@ def tp_dryrun(tp: int) -> dict:
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=sorted(_CONFIGS), default=None,
+    ap.add_argument("--model", choices=sorted(_CONFIGS) + ["llama7b"],
+                    default=None,
                     help="run ONE config (no fallback chain); default: "
-                    "large with medium fallback")
+                    "large with medium fallback.  'llama7b' is valid only "
+                    "with --dryrun (7B cannot run unsharded on one chip)")
     ap.add_argument("--batch", type=int, default=0, help="override batch size")
     ap.add_argument("--steps", type=int, default=0,
                     help="override timing-step count")
@@ -439,9 +473,19 @@ if __name__ == "__main__":
     if a.platform:
         jax.config.update("jax_platforms", a.platform)
     if a.dryrun:
-        tp_dryrun(a.tp or 8)
+        if a.model not in (None, "llama7b", "1.3b"):
+            ap.error(f"--dryrun compiles fixed sharded configs "
+                     f"(default GPT-1.3B, or --model llama7b); "
+                     f"--model {a.model} would be silently ignored")
+        if a.batch or a.steps:
+            ap.error("--batch/--steps apply to the single-chip bench, "
+                     "not --dryrun")
+        tp_dryrun(a.tp or 8,
+                  "llama7b" if a.model == "llama7b" else "gpt-1.3b")
     elif a.tp:
         ap.error("--tp requires --dryrun (the single-chip bench ignores it)")
+    elif a.model == "llama7b":
+        ap.error("llama7b is compile-only: use --dryrun --model llama7b")
     else:
         main(a.model, a.batch or None, a.steps or None,
              attempts_per_config=a.attempts)

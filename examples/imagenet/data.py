@@ -147,6 +147,7 @@ class PrefetchLoader:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._done = object()
         self._stop = threading.Event()
+        self._error: BaseException | None = None
 
         def worker():
             try:
@@ -154,6 +155,11 @@ class PrefetchLoader:
                     if self._stop.is_set():
                         return
                     self._q.put(item)
+            except BaseException as e:  # noqa: BLE001 — re-raised in __next__
+                # a decode error (corrupt JPEG, bad path) must surface in
+                # the training loop as ITSELF, not as a bare StopIteration
+                # indistinguishable from clean end-of-data
+                self._error = e
             finally:
                 self._q.put(self._done)
 
@@ -166,6 +172,8 @@ class PrefetchLoader:
     def __next__(self):
         item = self._q.get()
         if item is self._done:
+            if self._error is not None:
+                raise self._error
             raise StopIteration
         return item
 

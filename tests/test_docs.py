@@ -20,7 +20,10 @@ MUST_MENTION = {
                     "ring_attention", "ExpertParallelMLP"],
     "normalization": ["FusedLayerNorm", "FusedRMSNorm"],
     "ops": ["flash_attention", "fused_lm_head_loss"],
-    "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline"],
+    # vit_l16/llama2_7b are @classmethod constructors — they pin the
+    # classmethod-rendering path of the generator
+    "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline",
+               "vit_l16", "llama2_7b"],
     "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
 }
 

@@ -121,14 +121,17 @@ def _render_symbol(name: str, obj) -> list[str]:
         d = _doc_first_block(obj)
         if d:
             lines.append(d + "\n")
-        # public methods defined on the class itself
+        # public methods defined on the class itself.  NB classmethod
+        # objects are NOT callable() in CPython 3.12 — test the wrapper
+        # types first or every @classmethod constructor vanishes
         for mname, m in sorted(vars(obj).items()):
-            if mname.startswith("_") or not callable(m):
+            is_wrapped = isinstance(m, (classmethod, staticmethod))
+            if mname.startswith("_") or not (is_wrapped or callable(m)):
                 continue
             try:
-                func = m.__func__ if isinstance(m, (classmethod,
-                                                    staticmethod)) else m
-                lines.append(f"- **`.{mname}{_sig(func)}`** — "
+                func = m.__func__ if is_wrapped else m
+                kind = "classmethod " if isinstance(m, classmethod) else ""
+                lines.append(f"- **{kind}`.{mname}{_sig(func)}`** — "
                              f"{_doc_first_block(func) or '(no doc)'}")
             except Exception:
                 continue

@@ -315,7 +315,7 @@ def _kernel_ok(t, hid, block_t) -> bool:
 
 
 def fused_lm_head_loss(hidden, embedding, labels, *, block_t: int = 512,
-                       block_v: int = 1536):
+                       block_v: int | None = None):
     """Per-token cross-entropy of ``hidden @ embedding.T`` without ever
     materializing the logits.
 
@@ -331,7 +331,10 @@ def fused_lm_head_loss(hidden, embedding, labels, *, block_t: int = 512,
         ``jnp.where(labels == ignore, 0.0, loss)`` with safe labels.
       block_t / block_v: token / vocab tile sizes (vocab is padded to
         block_v internally; tokens must divide block_t for the kernel
-        path, else the materialized reference runs).
+        path, else the materialized reference runs).  ``block_v=None``
+        (default) picks 1536, auto-shrunk past hid=1280 to fit the
+        ~16 MiB VMEM budget; an explicit ``block_v`` is honored as given
+        (ADVICE r4: no silent clamp of caller-supplied tiles).
 
     Returns per-token loss ``[...]`` in fp32: ``logsumexp(logits) -
     logits[label]``.
@@ -344,10 +347,13 @@ def fused_lm_head_loss(hidden, embedding, labels, *, block_t: int = 512,
     # the fwd VMEM footprint is dominated by the double-buffered e tile
     # (vb*hid) plus the fp32 score tile (tb*vb): the default 512x1536 fits
     # at hid<=1280 but overflows the ~16 MiB scoped budget at hid=2048
-    # (measured: 17.25M requested compiling the 1.3B config) — shrink the
-    # vocab tile as hid grows past the tuned point
-    if hid > 1280:
-        block_v = min(block_v, max(128, (1536 * 1280 // hid) // 128 * 128))
+    # (measured: 17.25M requested compiling the 1.3B config) — the default
+    # vocab tile shrinks as hid grows past the tuned point; an explicit
+    # block_v is the caller's choice and is not overridden
+    if block_v is None:
+        block_v = 1536
+        if hid > 1280:
+            block_v = max(128, (1536 * 1280 // hid) // 128 * 128)
     if _kernel_ok(t, hid, block_t):
         loss = _fused(h2, embedding, lab, min(block_t, t), block_v)
     else:

@@ -23,8 +23,8 @@ from apex_tpu.optimizers._common import FusedOptimizer, bias_corrections, tree_m
 
 class AdamState(NamedTuple):
     step: jax.Array  # i32 on device (capturable parity)
-    exp_avg: Any  # m, fp32
-    exp_avg_sq: Any  # v, fp32
+    exp_avg: Any  # m, stored in state_dtype (fp32 default)
+    exp_avg_sq: Any  # v, stored in state_dtype (fp32 default)
 
 
 class FusedAdam(FusedOptimizer):
@@ -38,7 +38,14 @@ class FusedAdam(FusedOptimizer):
         weight_decay: float = 0.0,
         amsgrad: bool = False,
         master_weights: bool = False,
+        state_dtype: Any = jnp.float32,
     ):
+        """``state_dtype`` stores m/v in reduced precision (the same HBM-traffic
+        lever as ``FusedLAMB(state_dtype=...)``): each step loads them, computes
+        in fp32, and stores back in ``state_dtype``.  At 1B+ params bf16 moments
+        halve both the optimizer state footprint and its per-step read+write
+        traffic; trajectory parity vs fp32 state is pinned in
+        tests/test_optimizers.py."""
         if amsgrad:
             # fused_adam.py:102 raises the same way
             raise RuntimeError("FusedAdam does not support the AMSGrad variant.")
@@ -49,9 +56,10 @@ class FusedAdam(FusedOptimizer):
         self.eps = eps
         self.adam_w_mode = adam_w_mode
         self.weight_decay = weight_decay
+        self.state_dtype = state_dtype
 
     def _init(self, params: Any) -> AdamState:
-        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, self.state_dtype), params)
         return AdamState(step=jnp.int32(0), exp_avg=zeros, exp_avg_sq=jax.tree.map(jnp.copy, zeros))
 
     def _update(self, grads: Any, params: Any, state: AdamState):
@@ -64,8 +72,11 @@ class FusedAdam(FusedOptimizer):
         wd = jnp.float32(self.weight_decay)
         b1, b2, eps = self.beta1, self.beta2, self.eps
 
+        sdt = self.state_dtype
+
         def leaf(p, g, m, v):
             p32 = p.astype(jnp.float32)
+            m, v = m.astype(jnp.float32), v.astype(jnp.float32)
             if not self.adam_w_mode and self.weight_decay:
                 g = g + wd * p32  # ADAM_MODE_0: L2 into the gradient
             m = b1 * m + (1.0 - b1) * g
@@ -74,7 +85,7 @@ class FusedAdam(FusedOptimizer):
             if self.adam_w_mode and self.weight_decay:
                 update = update + wd * p32  # ADAM_MODE_1: decoupled wd
             new_p = p32 - lr * update
-            return new_p.astype(p.dtype), m, v
+            return new_p.astype(p.dtype), m.astype(sdt), v.astype(sdt)
 
         new_p, new_m, new_v = tree_map_multi(
             leaf, 3, params, grads, state.exp_avg, state.exp_avg_sq

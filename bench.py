@@ -1,7 +1,12 @@
-"""Benchmark: GPT training-step throughput on the available device(s).
+"""Benchmark: training-step throughput on the available device(s).
 
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+Prints one JSON line per captured config — flagship first, then (default
+run, deadline permitting) the GPT-1.3B and Llama-1B configs — and, when
+extras were captured, a FINAL combined line that repeats the flagship
+headline fields plus ``additional_configs: [...]`` holding every other
+captured result (so a last-line consumer records all of them):
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...,
+   "additional_configs": [...]}
 
 The flagship config is a GPT-2-large (774M) causal LM trained with the
 full apex_tpu stack (flash attention, fused LN kernels, fused LM-head CE
@@ -9,6 +14,8 @@ kernel, FusedLAMB with bf16 moments — the BASELINE.md north-star
 optimizer, bf16 O2 policy, donated buffers) — r4 measured 0.483 MFU.
 ``--model 1.3b`` runs a GPT 1.3B on the same single chip (activation
 recompute + bf16 LAMB moments to fit 16 GB HBM) at 0.451 MFU.
+``--model llama-1b`` runs a ~1.1B Llama (GQA 4:1, SwiGLU, RMSNorm, rope,
+seq 2048) with FusedAdam bf16 moments — the measured Llama row.
 
 ``vs_baseline`` is measured MFU / 0.45 (the BASELINE.md target), so 1.0
 means the target is met.  This definition is fixed as of r3 (r2 used a
@@ -58,6 +65,8 @@ _PEAK_TFLOPS = {"v5 lite": 197.0, "v5e": 197.0, "v5p": 459.0, "v4": 275.0,
 
 # Model cards.  remat/state_dtype are the memory levers that let each
 # config fit one 16 GB v5e chip (PERF_NOTES.md has the accounting).
+# ``metric`` is the stable metric-name stem (no dots/dashes — downstream
+# consumers key on it; ADVICE r4).  ``family`` picks the model class.
 _CONFIGS = {
     # 774M flagship: NO activation recompute; bf16 LAMB moments (the r4
     # HBM-traffic lever: fp32 state measures 456 ms/step = 0.449 MFU,
@@ -65,11 +74,13 @@ _CONFIGS = {
     # read+write traffic; trajectory parity pinned in test_optimizers).
     # batch 12 regresses (0.459, memory pressure) and batch 16 does not
     # fit even with except_activations remat — measured r4, PERF_NOTES.md
-    "large": dict(layers=36, hidden=1280, heads=20, vocab=50304,
+    "large": dict(metric="gpt2_large", family="gpt",
+                  layers=36, hidden=1280, heads=20, vocab=50304,
                   seq=1024, batch=8, steps=8,
                   remat=None, state_dtype="bfloat16"),
     # 355M: the r2 flagship, kept as the fallback config
-    "medium": dict(layers=24, hidden=1024, heads=16, vocab=50304,
+    "medium": dict(metric="gpt2_medium", family="gpt",
+                   layers=24, hidden=1024, heads=16, vocab=50304,
                    seq=1024, batch=8, steps=8,
                    remat=None, state_dtype="float32"),
     # 1.3B: bf16 moments (fused_lamb.py state_dtype) + FULL per-layer
@@ -77,21 +88,36 @@ _CONFIGS = {
     # 'except_activations' policy keeps every matmul output and measures
     # 26 GB total at this scale (compile log, r4) — only whole-layer
     # recompute (saved residual = one [s,b,h] per layer, 0.8 GB) fits
-    "1.3b": dict(layers=24, hidden=2048, heads=32, vocab=50304,
+    "1.3b": dict(metric="gpt2_1p3b", family="gpt",
+                 layers=24, hidden=2048, heads=32, vocab=50304,
                  seq=1024, batch=8, steps=4,
                  remat="full", state_dtype="bfloat16"),
-    "cpu-smoke": dict(layers=2, hidden=128, heads=4, vocab=1024,
+    # Llama ~1.1B at the real architecture ratios (GQA 4:1, SwiGLU,
+    # RMSNorm, rope, untied head — BASELINE.md row 5's component set on
+    # one chip): the measured on-chip Llama row (VERDICT r4 item 2).
+    # FusedAdam per the row ("multi-tensor Adam"); bf16 moments to fit.
+    "llama-1b": dict(metric="llama_1b", family="llama",
+                     layers=22, hidden=2048, heads=32, kv_heads=8,
+                     intermediate=5632, vocab=32000,
+                     seq=2048, batch=4, steps=6,
+                     remat=None, state_dtype="bfloat16",
+                     optimizer="adam"),
+    "cpu-smoke": dict(metric="gpt2_cpu_smoke", family="gpt",
+                      layers=2, hidden=128, heads=4, vocab=1024,
                       seq=128, batch=2, steps=2,
                       remat=None, state_dtype="float32"),
 }
 
 # transient runtime errors worth retrying (observed: BENCH_r03.json died
 # on "INTERNAL: ... remote_compile"; also seen: stream/tunnel resets).
+# Case-sensitive, status-code-anchored (ADVICE r4: bare lowercase
+# 'internal'/'stream'/'connection' substrings also match deterministic
+# XLA failure text and burned the retry budget on hard errors).
 # RESOURCE_EXHAUSTED (OOM) is deliberately NOT here: it is deterministic,
 # and the right move is the next-smaller config, not a retry.
 _TRANSIENT_MARKERS = (
-    "remote_compile", "INTERNAL", "UNAVAILABLE", "DEADLINE_EXCEEDED",
-    "Socket", "stream", "Connection",
+    "remote_compile", "INTERNAL:", "UNAVAILABLE:", "DEADLINE_EXCEEDED",
+    "Socket closed", "Connection reset", "Stream removed",
 )
 
 
@@ -107,8 +133,7 @@ def run_config(name: str, *, batch: int | None = None,
                steps: int | None = None, seq: int | None = None) -> dict:
     """Build everything from scratch, run the timing protocol, return the
     result dict.  Raises on any failure — the caller owns retry policy."""
-    from apex_tpu.optimizers import FusedLAMB
-    from apex_tpu.transformer.testing import GPTModel
+    from apex_tpu.optimizers import FusedAdam, FusedLAMB
 
     cfg = dict(_CONFIGS[name])
     if batch:
@@ -125,14 +150,31 @@ def run_config(name: str, *, batch: int | None = None,
 
     # remat: None = no recompute; "full" = whole-layer recompute (policy
     # None under activations_checkpoint); else a named jax checkpoint policy
-    model = GPTModel(
-        num_layers=cfg["layers"], hidden_size=cfg["hidden"],
-        num_attention_heads=cfg["heads"], vocab_size=cfg["vocab"],
-        max_sequence_length=cfg["seq"], params_dtype=jnp.float32,
-        activations_checkpoint=bool(cfg["remat"]),
-        activations_checkpoint_policy=(
-            None if cfg["remat"] in (None, "full") else cfg["remat"]))
-    opt = FusedLAMB(lr=1e-3, state_dtype=jnp.dtype(cfg["state_dtype"]))
+    if cfg["family"] == "llama":
+        from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        model = LlamaForCausalLM(
+            LlamaConfig(vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
+                        intermediate_size=cfg["intermediate"],
+                        num_hidden_layers=cfg["layers"],
+                        num_attention_heads=cfg["heads"],
+                        num_key_value_heads=cfg["kv_heads"],
+                        max_position_embeddings=cfg["seq"]),
+            activations_checkpoint=bool(cfg["remat"]))
+    else:
+        from apex_tpu.transformer.testing import GPTModel
+
+        model = GPTModel(
+            num_layers=cfg["layers"], hidden_size=cfg["hidden"],
+            num_attention_heads=cfg["heads"], vocab_size=cfg["vocab"],
+            max_sequence_length=cfg["seq"], params_dtype=jnp.float32,
+            activations_checkpoint=bool(cfg["remat"]),
+            activations_checkpoint_policy=(
+                None if cfg["remat"] in (None, "full") else cfg["remat"]))
+    opt_name = cfg.get("optimizer", "lamb")
+    sdt = jnp.dtype(cfg["state_dtype"])
+    opt = (FusedAdam(lr=1e-3, state_dtype=sdt) if opt_name == "adam"
+           else FusedLAMB(lr=1e-3, state_dtype=sdt))
 
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg["vocab"], (cfg["batch"], cfg["seq"])),
@@ -208,8 +250,20 @@ def run_config(name: str, *, batch: int | None = None,
         assert 0.0 < mfu <= 1.0, (
             f"measured MFU {mfu:.3f} is not physical — measurement error")
 
+    out_cfg = {"model": name, "layers": cfg["layers"],
+               "hidden": cfg["hidden"], "heads": cfg["heads"],
+               "vocab": cfg["vocab"], "seq": cfg["seq"],
+               "batch": cfg["batch"],
+               "params_m": round(n_params / 1e6, 1),
+               "optimizer": "FusedAdam" if opt_name == "adam" else "FusedLAMB",
+               "state_dtype": cfg["state_dtype"],
+               "remat": cfg["remat"],
+               "loss0": round(loss0, 4), "loss_end": round(loss_2n, 4)}
+    if cfg["family"] == "llama":
+        out_cfg["kv_heads"] = cfg["kv_heads"]
+        out_cfg["intermediate"] = cfg["intermediate"]
     return {
-        "metric": f"gpt2_{name}_tokens_per_sec_per_chip",
+        "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec / n_chips, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
@@ -218,49 +272,29 @@ def run_config(name: str, *, batch: int | None = None,
         "step_time_ms": round(step_time * 1e3, 2),
         "n_chips": n_chips,
         "device": str(dev.device_kind),
-        "config": {"model": name, "layers": cfg["layers"],
-                   "hidden": cfg["hidden"], "heads": cfg["heads"],
-                   "vocab": cfg["vocab"], "seq": cfg["seq"],
-                   "batch": cfg["batch"],
-                   "params_m": round(n_params / 1e6, 1),
-                   "optimizer": "FusedLAMB",
-                   "state_dtype": cfg["state_dtype"],
-                   "remat": cfg["remat"],
-                   "loss0": round(loss0, 4), "loss_end": round(loss_2n, 4)},
+        "config": out_cfg,
     }
 
 
-def main(model: str | None, batch: int | None, steps: int | None,
-         attempts_per_config: int = 3, deadline_s: float = 1500.0) -> None:
-    on_tpu = jax.devices()[0].platform == "tpu"
-    if model is None:
-        # default chain: flagship, then the proven-smaller fallback
-        chain = ["large", "medium"] if on_tpu else ["cpu-smoke"]
-    else:
-        chain = [model]  # explicit --model is honored on ANY platform
-
-    t_start = time.monotonic()
-    errors: list[str] = []
+def _capture_chain(chain: list[str], *, batch: int | None, steps: int | None,
+                   attempts_per_config: int, t_start: float, deadline_s: float,
+                   errors: list[str]) -> tuple[dict | None, int]:
+    """Try each config in ``chain`` with bounded retries; return the first
+    captured result (annotated with attempts/fallback) or None, plus the
+    number of attempts consumed."""
     n_attempts = 0
-    deadline_hit = False
     for config in chain:
-        if deadline_hit:
-            break
         for _ in range(attempts_per_config):
             if n_attempts and time.monotonic() - t_start > deadline_s:
                 errors.append(f"deadline {deadline_s}s exceeded; "
                               "not starting another attempt")
-                deadline_hit = True
-                break
+                return None, n_attempts
             n_attempts += 1
             try:
                 result = run_config(config, batch=batch, steps=steps)
                 result["attempts"] = n_attempts
                 result["fallback"] = config != chain[0]
-                if errors:
-                    result["errors"] = errors
-                print(json.dumps(result))
-                return
+                return result, n_attempts
             except Exception as e:  # noqa: BLE001 — the whole point is capture
                 msg = f"{config}: {type(e).__name__}: {e}"
                 errors.append(msg[:500])
@@ -270,8 +304,7 @@ def main(model: str | None, batch: int | None, steps: int | None,
                 # errors (OOM, shape bugs) are deterministic, so burn no
                 # budget re-proving that: jump straight to the next config
                 transient = (isinstance(e, AssertionError)
-                             or any(m.lower() in str(e).lower()
-                                    for m in _TRANSIENT_MARKERS))
+                             or any(m in str(e) for m in _TRANSIENT_MARKERS))
                 try:
                     jax.clear_caches()
                 except Exception:
@@ -283,14 +316,80 @@ def main(model: str | None, batch: int | None, steps: int | None,
                 print(f"[bench] attempt {n_attempts} failed (transient); "
                       f"retrying fresh", file=sys.stderr)
                 time.sleep(5.0)
+    return None, n_attempts
 
-    # every config failed: still emit one JSON line, then fail loudly
-    print(json.dumps({
-        "metric": "gpt2_bench_failed", "value": 0.0, "unit": "tokens/s/chip",
-        "vs_baseline": 0.0, "ok": False, "attempts": n_attempts,
-        "errors": errors,
-    }))
-    sys.exit(1)
+
+# started after the flagship only if this much budget remains: one extra
+# config costs ~compile (20-60 s) + a few timed steps + retry slack
+_EXTRA_RESERVE_S = 420.0
+
+
+def main(model: str | None, batch: int | None, steps: int | None,
+         attempts_per_config: int = 3, deadline_s: float = 1500.0) -> None:
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if model is None:
+        # default chain: flagship, then the proven-smaller fallback.
+        # After the flagship is captured, the remaining headline configs
+        # run deadline-aware so the round record carries every measured
+        # model family (VERDICT r4 item 3), flagship first.
+        chain = ["large", "medium"] if on_tpu else ["cpu-smoke"]
+        extras = ["1.3b", "llama-1b"] if on_tpu else []
+    else:
+        chain = [model]  # explicit --model is honored on ANY platform
+        extras = []
+
+    t_start = time.monotonic()
+    errors: list[str] = []
+    primary, n_attempts = _capture_chain(
+        chain, batch=batch, steps=steps,
+        attempts_per_config=attempts_per_config,
+        t_start=t_start, deadline_s=deadline_s, errors=errors)
+    if primary is None:
+        # every config failed: still emit one JSON line, then fail loudly
+        print(json.dumps({
+            "metric": "gpt2_bench_failed", "value": 0.0,
+            "unit": "tokens/s/chip", "vs_baseline": 0.0, "ok": False,
+            "attempts": n_attempts, "errors": errors,
+        }))
+        sys.exit(1)
+    if errors:
+        primary["errors"] = errors
+    print(json.dumps(primary))  # flagship line first, as soon as captured
+    sys.stdout.flush()
+
+    additional: list[dict] = []
+    for config in extras:
+        remaining = deadline_s - (time.monotonic() - t_start)
+        if remaining < _EXTRA_RESERVE_S:
+            print(f"[bench] skipping extra config {config}: "
+                  f"{remaining:.0f}s left < {_EXTRA_RESERVE_S:.0f}s reserve",
+                  file=sys.stderr)
+            break
+        extra_errors: list[str] = []
+        # --steps/--attempts are honored (capped at 2 attempts — extras are
+        # best-effort); --batch is NOT: each extra card's batch is HBM-tuned
+        # for its own memory plan, and the flagship's override would OOM it
+        r, _ = _capture_chain([config], batch=None, steps=steps,
+                              attempts_per_config=min(2, attempts_per_config),
+                              t_start=t_start,
+                              deadline_s=deadline_s - 60.0,
+                              errors=extra_errors)
+        if r is not None:
+            if extra_errors:
+                r["errors"] = extra_errors
+            print(json.dumps(r))
+            sys.stdout.flush()
+            additional.append(r)
+        else:
+            print(f"[bench] extra config {config} not captured: "
+                  f"{extra_errors}", file=sys.stderr)
+
+    if additional:
+        # final combined line = flagship headline + every captured config,
+        # so a last-line consumer records all of them in one object
+        combined = dict(primary)
+        combined["additional_configs"] = additional
+        print(json.dumps(combined))
 
 
 def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:

@@ -24,6 +24,7 @@ import argparse
 import contextlib
 import functools
 import os
+import sys
 import time
 
 import jax
@@ -160,9 +161,24 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
 
     loader = None
     if data_dir is not None:
-        import sys
-        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-        from data import ImageFolder, PrefetchLoader, batch_iterator
+        # load the sibling data.py under a unique module name — mutating
+        # sys.path and importing a bare 'data' can shadow any other 'data'
+        # module in a host process (ADVICE r4)
+        import importlib.util
+        name = "apex_tpu_examples_imagenet_data"
+        if name in sys.modules:
+            data_mod = sys.modules[name]
+        else:
+            spec = importlib.util.spec_from_file_location(
+                name,
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "data.py"))
+            data_mod = importlib.util.module_from_spec(spec)
+            sys.modules[name] = data_mod  # idempotent across sweep calls
+            spec.loader.exec_module(data_mod)
+        ImageFolder = data_mod.ImageFolder
+        PrefetchLoader = data_mod.PrefetchLoader
+        batch_iterator = data_mod.batch_iterator
 
         dataset = ImageFolder(data_dir)
         num_classes = len(dataset.classes)

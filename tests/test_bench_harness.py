@@ -3,8 +3,9 @@
 Round 3's driver capture died on a single transient axon ``remote_compile``
 error (BENCH_r03.json rc=1) because bench.py had no retry path.  These tests
 pin the harness contract: bounded retries per config, fallback to the next
-smaller model, ONE JSON line on stdout no matter what, and a non-zero exit
-only when every config is exhausted.
+smaller model, at least one JSON line on stdout no matter what (flagship
+first; extra configs and a combined final line when captured), and a
+non-zero exit only when every primary config is exhausted.
 """
 
 import io
@@ -53,6 +54,19 @@ def test_retry_then_success(monkeypatch, no_sleep):
     assert "remote_compile" in result["errors"][0]
 
 
+def _tpu_lines(monkeypatch, **kw):
+    """Run main(model=None) on a mocked TPU backend; return parsed lines."""
+    monkeypatch.setattr(
+        bench.jax, "devices",
+        lambda *a: [type("D", (), {"platform": "tpu"})()])
+    out = io.StringIO()
+    monkeypatch.setattr(sys, "stdout", out)
+    bench.main(None, None, None, **kw)
+    sys.stdout = sys.__stdout__
+    return [json.loads(ln) for ln in out.getvalue().splitlines()
+            if ln.strip()]
+
+
 def test_fallback_to_next_config(monkeypatch, no_sleep):
     def flaky(name, **kw):
         if name == "large":
@@ -60,17 +74,45 @@ def test_fallback_to_next_config(monkeypatch, no_sleep):
         return {"metric": f"gpt2_{name}", "value": 1.0}
 
     monkeypatch.setattr(bench, "run_config", flaky)
-    monkeypatch.setattr(
-        bench.jax, "devices",
-        lambda *a: [type("D", (), {"platform": "tpu"})()])
-    out = io.StringIO()
-    monkeypatch.setattr(sys, "stdout", out)
-    bench.main(None, None, None, attempts_per_config=2)
-    sys.stdout = sys.__stdout__
-    result = json.loads(out.getvalue().strip())
+    lines = _tpu_lines(monkeypatch, attempts_per_config=2)
+    result = lines[0]
     assert result["metric"] == "gpt2_medium"
     assert result["fallback"] is True
     assert result["attempts"] == 3  # 2 failed large + 1 medium
+
+
+def test_default_run_captures_extra_configs(monkeypatch, no_sleep):
+    """The default run appends the 1.3B + Llama configs after the flagship
+    (VERDICT r4 item 3) and ends with ONE combined line carrying them all."""
+    calls = []
+
+    def ok(name, **kw):
+        calls.append(name)
+        return {"metric": f"m_{name}", "value": 1.0}
+
+    monkeypatch.setattr(bench, "run_config", ok)
+    lines = _tpu_lines(monkeypatch)
+    assert calls == ["large", "1.3b", "llama-1b"]
+    # flagship line first, each extra as its own line, combined line last
+    assert [ln["metric"] for ln in lines[:3]] == [
+        "m_large", "m_1.3b", "m_llama-1b"]
+    combined = lines[-1]
+    assert combined["metric"] == "m_large"
+    assert [r["metric"] for r in combined["additional_configs"]] == [
+        "m_1.3b", "m_llama-1b"]
+
+
+def test_extra_config_failure_does_not_fail_run(monkeypatch, no_sleep):
+    """A dead extra config must not damage the captured flagship result."""
+    def flaky(name, **kw):
+        if name != "large":
+            raise RuntimeError("INTERNAL: stream broken")
+        return {"metric": f"m_{name}", "value": 1.0}
+
+    monkeypatch.setattr(bench, "run_config", flaky)
+    lines = _tpu_lines(monkeypatch)
+    assert lines[0]["metric"] == "m_large"
+    assert all("additional_configs" not in ln for ln in lines)
 
 
 def test_hard_error_skips_retries(monkeypatch, no_sleep):
@@ -85,16 +127,29 @@ def test_hard_error_skips_retries(monkeypatch, no_sleep):
         return {"metric": f"gpt2_{name}", "value": 1.0}
 
     monkeypatch.setattr(bench, "run_config", flaky)
-    monkeypatch.setattr(
-        bench.jax, "devices",
-        lambda *a: [type("D", (), {"platform": "tpu"})()])
+    lines = _tpu_lines(monkeypatch, attempts_per_config=3)
+    # no second 'large' attempt; extras still run after the fallback
+    assert calls == ["large", "medium", "1.3b", "llama-1b"]
+    assert lines[0]["fallback"] is True
+
+
+def test_transient_markers_are_code_anchored(monkeypatch, no_sleep):
+    """ADVICE r4: lowercase 'internal'/'stream'/'connection' words in a
+    deterministic failure message must be classified hard (one attempt),
+    not transient (full retry budget)."""
+    calls = []
+
+    def broken(name, **kw):
+        calls.append(name)
+        raise RuntimeError("lowering failed: internal stream connection op")
+
+    monkeypatch.setattr(bench, "run_config", broken)
     out = io.StringIO()
     monkeypatch.setattr(sys, "stdout", out)
-    bench.main(None, None, None, attempts_per_config=3)
+    with pytest.raises(SystemExit):
+        bench.main("cpu-smoke", None, None, attempts_per_config=3)
     sys.stdout = sys.__stdout__
-    result = json.loads(out.getvalue().strip())
-    assert calls == ["large", "medium"]  # no second 'large' attempt
-    assert result["fallback"] is True
+    assert len(calls) == 1  # hard error: no retries burned
 
 
 def test_all_fail_still_prints_json(monkeypatch, no_sleep):

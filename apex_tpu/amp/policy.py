@@ -75,6 +75,9 @@ class PrecisionPolicy:
         return out[0] if len(args) == 1 else out
 
     def cast_output(self, x):
+        """Cast floating leaves of a model output pytree to this policy's
+        ``output_dtype`` (O1/O2 return fp32 outputs from a half-precision
+        body, mirroring the reference's output-cast contract)."""
         def cast(leaf):
             if isinstance(leaf, jax.Array) and jnp.issubdtype(leaf.dtype, jnp.floating):
                 return leaf.astype(self.output_dtype)
@@ -93,6 +96,9 @@ class PrecisionPolicy:
         return wrapped
 
     def make_scaler(self) -> LossScaler:
+        """The loss scaler this policy prescribes: dynamic (fp16 default),
+        static at a fixed value, or the identity static-1.0 scaler when
+        ``loss_scale`` is None (bf16 policies need no scaling)."""
         if self.loss_scale == "dynamic":
             return LossScaler()
         if self.loss_scale is None:

@@ -52,6 +52,8 @@ class LossScaler:
     dynamic: bool = True
 
     def init(self) -> LossScalerState:
+        """Fresh on-device scaler state at ``init_scale`` with zeroed
+        growth/unskipped counters and a full hysteresis budget."""
         return LossScalerState(
             scale=jnp.float32(self.init_scale),
             growth_tracker=jnp.int32(0),
@@ -116,9 +118,13 @@ class LossScaler:
 
     # -- checkpoint parity (amp.state_dict / load_state_dict; README.md:66-104) --
     def state_dict(self, state: LossScalerState) -> dict:
+        """Host-side dict of the scaler state (scale + trackers), the
+        checkpointable form of ``amp.state_dict()``."""
         return {k: jax.device_get(v) for k, v in state._asdict().items()}
 
     def load_state_dict(self, d: dict) -> LossScalerState:
+        """Rebuild on-device scaler state from a ``state_dict`` dict —
+        exact-trajectory resume of the dynamic scale and its trackers."""
         return LossScalerState(
             scale=jnp.float32(d["scale"]),
             growth_tracker=jnp.int32(d["growth_tracker"]),

@@ -58,5 +58,7 @@ class FocalLoss:
     @staticmethod
     def apply(cls_output, cls_targets_at_level, num_positives_sum,
               num_real_classes, alpha, gamma, label_smoothing=0.0):
+        """Sigmoid focal loss summed over a detection level, normalized by
+        ``num_positives_sum`` (focal_loss.py fwd contract)."""
         return focal_loss(cls_output, cls_targets_at_level, num_positives_sum,
                           num_real_classes, alpha, gamma, label_smoothing)

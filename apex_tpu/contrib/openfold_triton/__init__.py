@@ -120,6 +120,8 @@ class LayerNormSmallShapeOptImpl:
 
     @staticmethod
     def apply(inputs, normalized_shape, weight, bias, eps=1e-5):
+        """Affine LayerNorm over ``normalized_shape`` via the Pallas
+        fused kernel (drop-in for the Triton small-shape impl)."""
         return fused_layer_norm_affine(inputs, weight, bias,
                                        normalized_shape, eps=eps)
 

@@ -67,6 +67,8 @@ class FusedAdamSWA:
         self.swa_start_step = swa_start_step
 
     def init(self, params: Any) -> AdamSWAState:
+        """State: zero moments + fp32 master AND SWA copies of ``params``
+        (fused_adam_swa.py state layout)."""
         z = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
         f32 = lambda: jax.tree.map(
             lambda p: jnp.copy(p).astype(jnp.float32), params)
@@ -76,6 +78,9 @@ class FusedAdamSWA:
     def step(self, grads: Any, params: Any, state: AdamSWAState, *,
              grad_scale=None, found_inf=None
              ) -> Tuple[Any, AdamSWAState]:
+        """Adam update + (past ``swa_start_step``) the decaying SWA average
+        of the new params, in one fused sweep — two updates for one grad
+        read, the kernel's whole point."""
         step = state.step + 1
         g32 = unscale_grads(grads, grad_scale)
         if self.bias_correction:

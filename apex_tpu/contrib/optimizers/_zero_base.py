@@ -216,6 +216,9 @@ class ZeROOptimizer:
         )
 
     def init(self, params: Any) -> ZeROState:
+        """Flatten ``params`` into the padded fp32 buffer and keep only THIS
+        rank's contiguous shard of masters + moments (the ZeRO-2 state
+        partition; per-rank memory is ``padded_total/world``)."""
         spec, n, shard, rank = self._layout(params)
         self._check_remainder_dtypes(spec)
         flat32 = pack_pytree(params, dtype=jnp.float32, pad_to=1024 * n).flat
@@ -258,6 +261,11 @@ class ZeROOptimizer:
         grad_scale: Optional[jax.Array] = None,
         found_inf: Optional[jax.Array] = None,
     ):
+        """One ZeRO-2 step inside ``shard_map``: reduce-scatter the flat
+        grads to the owner shard (mean over the distributed axis), update
+        that shard locally, then all-gather the new params — no
+        all-reduce anywhere.  ``grad_scale``/``found_inf`` follow the
+        FusedOptimizer capturable contract (state revert on overflow)."""
         spec, n, shard, rank = self._layout(params)
         ax = self.distributed_axis
 

@@ -181,10 +181,14 @@ class ASP:
 
     @classmethod
     def masks(cls):
+        """The current {param name: 0/1 mask} dict (empty before
+        ``compute_sparse_masks``)."""
         return cls._masks
 
     @classmethod
     def state_dict(cls):
+        """Checkpointable snapshot: masks + pruned flag + pattern (restored
+        by ``load_state_dict`` for exact sparse-training resume)."""
         return {"masks": cls._masks, "pruned": cls._pruned,
                 "pattern": cls._pattern}
 

@@ -79,6 +79,9 @@ class SoftmaxCrossEntropyLoss:
     @staticmethod
     def apply(logits, labels, smoothing=0.0, padding_idx=0,
               half_to_float=False):
+        """Label-smoothed softmax cross-entropy per token; ``padding_idx``
+        positions get zero loss.  (``half_to_float`` accepted for API
+        parity; accumulation is always fp32.)"""
         del half_to_float  # losses are always accumulated/returned in fp32
         return softmax_cross_entropy_loss(logits, labels, smoothing,
                                           padding_idx)

@@ -70,13 +70,20 @@ class FP16Optimizer:
         )
 
     def init(self, params: Any) -> FP16OptimizerState:
+        """State = fp32 master copy of ``params`` + the inner optimizer's
+        state built over those masters + fresh scaler state."""
         master = master_copy(params)
         return FP16OptimizerState(master, self.inner.init(master), self.scaler.init())
 
     def scale_loss(self, loss, state: FP16OptimizerState):
+        """Multiply the loss by the current scale (differentiate the scaled
+        loss; ``step`` unscales the grads)."""
         return self.scaler.scale_loss(loss, state.scaler_state)
 
     def step(self, grads: Any, params: Any, state: FP16OptimizerState):
+        """Unscale grads to fp32, detect overflow, run the inner step on the
+        masters (skipped on overflow), cast masters back to the model dtype,
+        and advance the dynamic scale."""
         grads32, found_inf = self.scaler.unscale(
             tree_cast(grads, jnp.float32), state.scaler_state
         )

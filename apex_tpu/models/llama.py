@@ -69,10 +69,12 @@ class LlamaConfig:
 
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
+        """Llama-2 7B: 32 x 4096, MHA, 32k vocab (the dataclass defaults)."""
         return cls()
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
+        """Llama-3 8B: GQA 8 kv heads, 128k vocab, theta 5e5, 8k context."""
         return cls(vocab_size=128256, intermediate_size=14336,
                    num_key_value_heads=8, rope_theta=500000.0,
                    max_position_embeddings=8192)

@@ -61,6 +61,7 @@ class ViTConfig:
 
     @classmethod
     def vit_l16(cls) -> "ViTConfig":
+        """ViT-Large/16: 24 x 1024, 16 heads, 4096 MLP, 16px patches."""
         return cls(hidden_size=1024, num_hidden_layers=24,
                    num_attention_heads=16, intermediate_size=4096)
 

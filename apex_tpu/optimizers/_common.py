@@ -81,6 +81,9 @@ class FusedOptimizer:
 
     # -- public API --------------------------------------------------------
     def init(self, params: Any) -> Any:
+        """Build the optimizer state for ``params``: the subclass's inner
+        state (moments etc.) plus an fp32 master copy of the params when
+        ``master_weights=True`` (the reference's ``master_weights`` flag)."""
         inner = self._init(params)
         if self.master_weights:
             return (inner, MasterState(master_copy(params)))
@@ -95,6 +98,11 @@ class FusedOptimizer:
         grad_scale: Optional[jax.Array] = None,
         found_inf: Optional[jax.Array] = None,
     ):
+        """One optimizer step: ``(grads, params, state) -> (new_params,
+        new_state)``.  ``grad_scale`` divides the (loss-scaled) grads in
+        fp32 before the update; ``found_inf`` is the capturable skip — a
+        true flag returns params/state unchanged on device, with no host
+        sync (the reference's capturable step/scale/overflow contract)."""
         inner, masters = state
         g32 = unscale_grads(grads, grad_scale)
         work_params = masters.master_params if masters.master_params is not None else params

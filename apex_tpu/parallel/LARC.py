@@ -36,6 +36,7 @@ class LARC:
         return getattr(self.inner, name)
 
     def init(self, params: Any):
+        """Delegates to the wrapped optimizer — LARC itself is stateless."""
         return self.inner.init(params)
 
     def _adjust(self, grads: Any, params: Any) -> Any:
@@ -65,6 +66,9 @@ class LARC:
         return jax.tree.map(scale_leaf, grads, params)
 
     def step(self, grads: Any, params: Any, state: Any, **kw):
+        """Scale each grad by the layerwise trust ratio (wd folded in at
+        the adaptive rate), then run the wrapped optimizer's step with
+        its own weight decay suppressed."""
         adjusted = self._adjust(grads, params)
         # inner wd was folded into the adjusted grads (reference zeroes
         # group['weight_decay'] for the inner step)

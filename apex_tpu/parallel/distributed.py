@@ -105,6 +105,9 @@ class DistributedDataParallel:
     delay_allreduce: bool = False
 
     def allreduce(self, grads: Any) -> Any:
+        """Reduce grads across the dp axis (predivide/average/fp32 knobs
+        applied) — or pass through untouched when ``delay_allreduce`` is
+        set, to be reduced once by :meth:`sync` after accumulation."""
         if self.delay_allreduce:
             # the reference registers no hooks and reduces in one shot later
             return grads
@@ -152,6 +155,8 @@ class Reducer:
         self.axis_name = axis_name
 
     def reduce(self, tree: Any) -> Any:
+        """Mean-reduce every leaf across the axis (the reference Reducer's
+        allreduce-then-divide, as one psum inside shard_map/pmap)."""
         size = jax.lax.psum(1, self.axis_name)
         return jax.tree.map(
             lambda x: jax.lax.psum(x, self.axis_name) / jnp.asarray(size, x.dtype), tree

@@ -95,6 +95,8 @@ class FusedScaleMaskSoftmax:
 
     # keep the reference's name for the eager path
     def forward_torch_softmax(self, inputs, mask=None):
+        """The unfused reference path (scale → mask → softmax in fp32 when
+        ``softmax_in_fp32``), used when the kernel gate declines."""
         x = inputs.astype(jnp.float32) if self.softmax_in_fp32 else inputs
         if self.scale is not None:
             x = x * self.scale

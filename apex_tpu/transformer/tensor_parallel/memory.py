@@ -24,6 +24,7 @@ class MemoryBuffer:
         self._offset = 0
 
     def reset(self):
+        """Rewind the bump-allocator offset; existing views stay valid."""
         self._offset = 0
 
     def get(self, shape: Tuple[int, ...]):
@@ -45,6 +46,7 @@ class RingMemBuffer:
         self._index = -1
 
     def get_next_buffer(self) -> MemoryBuffer:
+        """Round-robin to the next buffer in the ring and reset it."""
         self._index = (self._index + 1) % self.num_buffers
         buf = self.buffers[self._index]
         buf.reset()

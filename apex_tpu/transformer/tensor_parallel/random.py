@@ -60,17 +60,23 @@ class RNGStatesTracker:
         self.counters_: Dict[str, int] = {}
 
     def reset(self):
+        """Drop every tracked RNG state (random.py reset parity)."""
         self.states_.clear()
         self.counters_.clear()
 
     def get_states(self) -> Dict[str, Any]:
+        """Snapshot of all tracked keys/counters (checkpointable)."""
         return {"keys": dict(self.states_), "counters": dict(self.counters_)}
 
     def set_states(self, states: Dict[str, Any]) -> None:
+        """Restore a :meth:`get_states` snapshot (exact-trajectory resume)."""
         self.states_ = dict(states["keys"])
         self.counters_ = dict(states["counters"])
 
     def add(self, name: str, seed) -> None:
+        """Register a named RNG stream from an int seed or PRNG key; the
+        tensor-model-parallel stream is seeded per-rank (random.py:
+        model_parallel_cuda_manual_seed parity)."""
         if name in self.states_:
             raise Exception(f"cuda rng state {name} already exists")
         if isinstance(seed, int):

@@ -34,12 +34,16 @@ class VocabUtility:
     @staticmethod
     def vocab_range_from_per_partition_vocab_size(
             per_partition_vocab_size: int, rank, world_size: int) -> Tuple:
+        """[first, last) global vocab ids owned by ``rank`` given the
+        per-rank partition size."""
         first = rank * per_partition_vocab_size
         return first, first + per_partition_vocab_size
 
     @staticmethod
     def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
                                            world_size: int) -> Tuple:
+        """[first, last) global vocab ids owned by ``rank``; the global size
+        must divide evenly (same contract as the reference)."""
         per_partition = divide(global_vocab_size, world_size)
         return VocabUtility.vocab_range_from_per_partition_vocab_size(
             per_partition, rank, world_size)

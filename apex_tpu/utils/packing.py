@@ -128,6 +128,8 @@ class PackedBuffer:
     spec: PackedSpec
 
     def unpack(self) -> Any:
+        """Rebuild the original pytree from the flat buffer (inverse of
+        ``pack_pytree``; zero-copy reshape/slice under jit)."""
         return unpack_pytree(self.flat, self.spec)
 
 

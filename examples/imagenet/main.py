@@ -175,7 +175,11 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
                              "data.py"))
             data_mod = importlib.util.module_from_spec(spec)
             sys.modules[name] = data_mod  # idempotent across sweep calls
-            spec.loader.exec_module(data_mod)
+            try:
+                spec.loader.exec_module(data_mod)
+            except BaseException:
+                sys.modules.pop(name, None)  # don't cache a half-import
+                raise
         ImageFolder = data_mod.ImageFolder
         PrefetchLoader = data_mod.PrefetchLoader
         batch_iterator = data_mod.batch_iterator

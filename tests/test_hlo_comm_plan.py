@@ -284,3 +284,64 @@ def test_wgrad_dots_present_and_fused(tp4_mesh):
         # on TPU the dots must keep bf16 operands (MXU-native); the CPU
         # backend legitimately upcasts since it has no bf16 ALU
         assert sum("bf16" in d for d in dots) >= 4, "\n".join(dots)
+
+
+def test_interleaved_vpp_collective_plan_is_exact(devices):
+    """Interleaved (vpp=2) 1F1B on pp=4: the schedule's claim — both wires
+    are SINGLE circular ppermutes with no per-chunk unroll — pinned on
+    compiled HLO.  Exactly 2 permute sites (fwd wire + bwd wire, same as
+    plain 1F1B: program size flat in vpp), ONE scalar loss all-reduce,
+    and zero grad collectives / gathers / scatters (chunk grads are
+    per-rank, never synced by the schedule).
+
+    Reference spec: fwd_bwd_pipelining_with_interleaving.py:27-560 — p2p
+    wires plus the embedding/loss reductions only, no grad collective.
+    """
+    from apex_tpu.transformer.pipeline_parallel import (
+        PipelineStageSpec,
+        forward_backward_pipelining_1f1b_interleaved,
+    )
+
+    vpp, pp = 2, 4
+    mesh = parallel_state.initialize_model_parallel(1, pp,
+                                                    devices=devices[:pp])
+    try:
+        def stage_fn(params, x):
+            return jax.nn.gelu(jnp.dot(x, params["w"]) + params["b"])
+
+        spec = PipelineStageSpec(
+            stage_fn=stage_fn,
+            first_fn=lambda params, mb: mb["x"],
+            last_fn=lambda params, y, mb: jnp.mean((y - mb["y"]) ** 2))
+        # global stage v*pp + r lives on rank r chunk v: leaves
+        # [vpp, pp, ...], sharded over the second dim
+        stacked = {"w": jnp.zeros((vpp, pp, 8, 8), jnp.float32),
+                   "b": jnp.zeros((vpp, pp, 8), jnp.float32)}
+        batches = {"x": jnp.zeros((4, 2, 8), jnp.float32),
+                   "y": jnp.zeros((4, 2, 8), jnp.float32)}
+
+        def run(stage_params, batches):
+            p = jax.tree.map(lambda l: l.squeeze(1), stage_params)
+            loss, grads = forward_backward_pipelining_1f1b_interleaved(
+                spec, p, batches, num_model_chunks=vpp)
+            return loss, jax.tree.map(lambda l: l[:, None], grads)
+
+        fn = jax.jit(shard_map(
+            run, mesh=mesh,
+            in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
+            out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
+            check_vma=False))
+        hlo = fn.lower(stacked, batches).compile().as_text()
+    finally:
+        parallel_state.destroy_model_parallel()
+
+    cp = _count(hlo, "collective-permute")
+    ar = _count(hlo, "all-reduce")
+    assert cp == 2, f"expected 2 permute sites (fwd wire + bwd wire): {cp}"
+    assert ar == 1, f"expected exactly the loss all-reduce: {ar}"
+    ar_lines = [ln for ln in hlo.splitlines()
+                if re.search(r"= (?:\([^)]*\)|\S+) all-reduce(?:-start)?\(",
+                             ln)]
+    assert len(ar_lines) == 1 and "f32[]" in ar_lines[0], ar_lines
+    assert _count(hlo, "all-gather") == 0
+    assert _count(hlo, "reduce-scatter") == 0

@@ -130,6 +130,13 @@ def _render_symbol(name: str, obj) -> list[str]:
                 continue
             try:
                 func = m.__func__ if is_wrapped else m
+                # skip dataclass FIELDS whose default happens to be a
+                # function (flax `kernel_init=nn.initializers.zeros` etc.)
+                # — they are data, not API methods.  A real method's
+                # qualname is anchored to this class.
+                qn = getattr(func, "__qualname__", "")
+                if not is_wrapped and not qn.startswith(obj.__name__ + "."):
+                    continue
                 kind = "classmethod " if isinstance(m, classmethod) else ""
                 lines.append(f"- **{kind}`.{mname}{_sig(func)}`** — "
                              f"{_doc_first_block(func) or '(no doc)'}")
@@ -188,13 +195,75 @@ def render_index() -> str:
     for key, (title, modules) in PAGES.items():
         mods = ", ".join(f"`{m.removeprefix('apex_tpu.')}`" for m in modules)
         lines.append(f"| [{title}](api/{key}.md) | {mods} |")
+    lines.append(QUICKSTART)
     lines.append(
-        "\nSee also: [README](../README.md) (quickstart + design map), "
+        "\nSee also: [README](../README.md) (design map), "
         "[PARITY.md](../PARITY.md) (component-by-component reference "
         "parity), [PERF_NOTES.md](../PERF_NOTES.md) (measured performance "
         "log), [BASELINE.md](../BASELINE.md) (targets and captured "
         "numbers).\n")
     return "\n".join(lines)
+
+
+QUICKSTART = """
+## Quickstart — amp → fused optimizer → TP → PP
+
+```python
+import jax, jax.numpy as jnp
+from apex_tpu import amp
+from apex_tpu.optimizers import FusedLAMB
+
+# 1. mixed precision: O2 casts the body to bf16, keeps fp32 masters
+amped = amp.initialize(model.apply, params, opt_level="O2",
+                       half_dtype=jnp.bfloat16)
+opt = FusedLAMB(lr=1e-3, master_weights=amped.policy.master_weights,
+                state_dtype=jnp.bfloat16)          # bf16 moments: ~7% MFU
+opt_state, sstate = opt.init(amped.params), amped.scaler_state
+
+@jax.jit
+def train_step(params, opt_state, sstate, batch):
+    def scaled_loss(p):
+        return amped.scaler.scale_loss(loss_fn(p, batch), sstate)
+    grads = jax.grad(scaled_loss)(params)
+    grads, found_inf = amped.scaler.unscale(grads, sstate)  # overflow skip
+    params, opt_state = opt.step(grads, params, opt_state,
+                                 found_inf=found_inf)
+    return params, opt_state, amped.scaler.update(sstate, found_inf)
+```
+
+Tensor parallelism (Megatron-style, with sequence parallelism) — build
+layers from `transformer.tensor_parallel` and run them under `shard_map`
+on a mesh from `parallel_state`:
+
+```python
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+mesh = parallel_state.initialize_model_parallel(tp, pp)   # ("dp","pp","tp")
+col = ColumnParallelLinear(h, 4 * h, gather_output=False,
+                           sequence_parallel_enabled=True, axis_name="tp")
+row = RowParallelLinear(4 * h, h, input_is_parallel=True,
+                        sequence_parallel_enabled=True, axis_name="tp")
+```
+
+Pipeline parallelism — describe the per-stage compute once and hand it to
+a schedule (`examples/gpt/pretrain.py --pp`, `examples/llama/pretrain.py`):
+
+```python
+from apex_tpu.transformer.pipeline_parallel import (
+    PipelineStageSpec, forward_backward_pipelining_1f1b)
+
+spec = PipelineStageSpec(stage_fn=block_fn, first_fn=embed_fn,
+                         last_fn=loss_fn)
+loss, grads = forward_backward_pipelining_1f1b(spec, stage_params, batches)
+```
+
+End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
+`examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),
+`examples/gpt/pretrain.py` (tp × pp × dp GPT), `examples/llama/pretrain.py`
+(3-D Llama), `examples/dcgan/main_amp.py` (two-model amp).
+"""
 
 
 def generate() -> dict[str, str]:

@@ -10,13 +10,19 @@ trust ratio ||p|| / ||update|| scaling the learning rate.
 ``use_nvlamb=True`` applies the trust ratio even for tensors excluded from
 weight decay (the NVLAMB variant note in fused_lamb.py).
 
-``packed=True`` scale caveat (r3, measured): at GPT-2-medium scale (355M
-params) the packed step did not complete a 25-step timing run within 30
-minutes on a v5e — the phase-2 per-tensor trust ratios run segment
-reductions over the full flat buffer, which XLA lowers to scatter-based
-code that degrades badly at hundreds of millions of elements.  The
-default (unpacked) path is the production path and the bench flagship
-configuration; packed is tested and fine at the scales its tests cover.
+``packed=True`` scale caveat (r3 measured, r5 re-measured after the dense
+reformulation): the phase-2 per-tensor trust ratios make packing LOSE on
+TPU at 100M+ params.  r3's segment-reduction form never completed at
+355M (scatter lowering); r5 rewrote the norms as dense static-slice
+reductions (ops/packed_update.py::per_leaf_sqnorms) — parity-pinned and
+functional, but still measured 45.9 ms at 103M vs the unpacked path's
+24 ms at 355M, with compile time growing superlinearly in leaf count:
+per-leaf reductions over one flat buffer cannot fuse with the Pallas
+phase-1 sweep, while the unpacked path fuses each leaf's norm into that
+leaf's update.  (The CUDA reference packs to amortize kernel-LAUNCH
+overhead; XLA has none to amortize.)  The default unpacked path is the
+production configuration (PERF_NOTES.md r5 table); packed remains
+parity-tested for the many-small-tensor case.
 
 ``state_dtype`` stores the moments (m, v) in a reduced precision while
 still *computing* every step in fp32 (cast up, update, cast back).  With
@@ -121,7 +127,8 @@ class FusedLAMB(FusedOptimizer):
             beta3=(1.0 - self.beta1 if self.grad_averaging else 1.0),
             eps=self.eps, weight_decay=self.weight_decay,
             bias_correction1=bc1, bias_correction2=bc2, global_clip=clip,
-            adam_w_mode=self.adam_w_mode, use_nvlamb=self.use_nvlamb)
+            adam_w_mode=self.adam_w_mode, use_nvlamb=self.use_nvlamb,
+            spec=spec)
         return unpack_pytree(new_p, spec), LambState(step, new_m, new_v)
 
     def _update(self, grads: Any, params: Any, state: LambState):

@@ -278,7 +278,8 @@ def run_config(name: str, *, batch: int | None = None,
 
 def _capture_chain(chain: list[str], *, batch: int | None, steps: int | None,
                    attempts_per_config: int, t_start: float, deadline_s: float,
-                   errors: list[str]) -> tuple[dict | None, int]:
+                   errors: list[str],
+                   seq: int | None = None) -> tuple[dict | None, int]:
     """Try each config in ``chain`` with bounded retries; return the first
     captured result (annotated with attempts/fallback) or None, plus the
     number of attempts consumed."""
@@ -291,7 +292,8 @@ def _capture_chain(chain: list[str], *, batch: int | None, steps: int | None,
                 return None, n_attempts
             n_attempts += 1
             try:
-                result = run_config(config, batch=batch, steps=steps)
+                result = run_config(config, batch=batch, steps=steps,
+                                    seq=seq)
                 result["attempts"] = n_attempts
                 result["fallback"] = config != chain[0]
                 return result, n_attempts
@@ -325,6 +327,7 @@ _EXTRA_RESERVE_S = 420.0
 
 
 def main(model: str | None, batch: int | None, steps: int | None,
+         seq: int | None = None,
          attempts_per_config: int = 3, deadline_s: float = 1500.0) -> None:
     on_tpu = jax.devices()[0].platform == "tpu"
     if model is None:
@@ -341,7 +344,7 @@ def main(model: str | None, batch: int | None, steps: int | None,
     t_start = time.monotonic()
     errors: list[str] = []
     primary, n_attempts = _capture_chain(
-        chain, batch=batch, steps=steps,
+        chain, batch=batch, steps=steps, seq=seq,
         attempts_per_config=attempts_per_config,
         t_start=t_start, deadline_s=deadline_s, errors=errors)
     if primary is None:
@@ -555,6 +558,10 @@ if __name__ == "__main__":
                     "large with medium fallback.  'llama7b' is valid only "
                     "with --dryrun (7B cannot run unsharded on one chip)")
     ap.add_argument("--batch", type=int, default=0, help="override batch size")
+    ap.add_argument("--seq", type=int, default=0,
+                    help="override sequence length for the primary config "
+                         "(use with --model; extras keep their own tuned "
+                         "seq, like --batch)")
     ap.add_argument("--steps", type=int, default=0,
                     help="override timing-step count")
     ap.add_argument("--attempts", type=int, default=3,
@@ -585,6 +592,12 @@ if __name__ == "__main__":
         ap.error("--tp requires --dryrun (the single-chip bench ignores it)")
     elif a.model == "llama7b":
         ap.error("llama7b is compile-only: use --dryrun --model llama7b")
+    elif a.seq and not a.model:
+        # without an explicit config the override would also hit the
+        # 'medium' fallback, whose HBM-tuned batch was never validated at
+        # other sequence lengths — the fallback could then OOM too
+        ap.error("--seq requires --model (the fallback chain keeps its "
+                 "own tuned shapes)")
     else:
-        main(a.model, a.batch or None, a.steps or None,
+        main(a.model, a.batch or None, a.steps or None, a.seq or None,
              attempts_per_config=a.attempts)

@@ -141,7 +141,8 @@ def load_checkpoint(path, template):
 def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
                  image_size=224, num_classes=1000, steps=20, lr=0.1,
                  loss_scale=None, save=None, save_interval=None, resume=None,
-                 prof=False, seed=0, verbose=True, data_dir=None):
+                 prof=False, seed=0, verbose=True, data_dir=None,
+                 workers=0):
     """Train on synthetic data (or a real image tree via ``data_dir``);
     returns the per-step loss trace + throughput.
 
@@ -187,7 +188,8 @@ def run_training(arch="resnet18", opt_level="O2", half="bf16", batch_size=64,
         dataset = ImageFolder(data_dir)
         num_classes = len(dataset.classes)
         loader = PrefetchLoader(batch_iterator(
-            dataset, batch_size, image_size, train=True, seed=seed))
+            dataset, batch_size, image_size, train=True, seed=seed,
+            workers=workers))
         if verbose:
             print(f"data: {len(dataset)} images, {num_classes} classes "
                   f"from {data_dir}")
@@ -330,6 +332,10 @@ def main():
     ap.add_argument("--data-dir", default=None,
                     help="ImageFolder tree (class_x/img.jpeg) of real "
                          "images (main_amp.py:95-123); default: synthetic")
+    ap.add_argument("--workers", type=int, default=0,
+                    help="decode threads for --data-dir (the reference "
+                         "DataLoader's workers; ~1 per 200 imgs/s needed, "
+                         "PERF_NOTES r5 input-pipeline section)")
     ap.add_argument("--prof", action="store_true",
                     help="jax.profiler trace of steps 5-10 (main_amp.py --prof)")
     args = ap.parse_args()
@@ -341,7 +347,8 @@ def main():
                  num_classes=args.num_classes, steps=args.steps, lr=args.lr,
                  loss_scale=loss_scale, save=args.save,
                  save_interval=args.save_interval, resume=args.resume,
-                 prof=args.prof, data_dir=args.data_dir)
+                 prof=args.prof, data_dir=args.data_dir,
+                 workers=args.workers)
 
 
 if __name__ == "__main__":

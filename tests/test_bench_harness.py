@@ -92,14 +92,14 @@ def test_default_run_captures_extra_configs(monkeypatch, no_sleep):
 
     monkeypatch.setattr(bench, "run_config", ok)
     lines = _tpu_lines(monkeypatch)
-    assert calls == ["large", "1.3b", "llama-1b"]
+    assert calls == ["large", "1.3b", "llama-1b", "resnet50"]
     # flagship line first, each extra as its own line, combined line last
-    assert [ln["metric"] for ln in lines[:3]] == [
-        "m_large", "m_1.3b", "m_llama-1b"]
+    assert [ln["metric"] for ln in lines[:4]] == [
+        "m_large", "m_1.3b", "m_llama-1b", "m_resnet50"]
     combined = lines[-1]
     assert combined["metric"] == "m_large"
     assert [r["metric"] for r in combined["additional_configs"]] == [
-        "m_1.3b", "m_llama-1b"]
+        "m_1.3b", "m_llama-1b", "m_resnet50"]
 
 
 def test_extra_config_failure_does_not_fail_run(monkeypatch, no_sleep):
@@ -129,7 +129,7 @@ def test_hard_error_skips_retries(monkeypatch, no_sleep):
     monkeypatch.setattr(bench, "run_config", flaky)
     lines = _tpu_lines(monkeypatch, attempts_per_config=3)
     # no second 'large' attempt; extras still run after the fallback
-    assert calls == ["large", "medium", "1.3b", "llama-1b"]
+    assert calls == ["large", "medium", "1.3b", "llama-1b", "resnet50"]
     assert lines[0]["fallback"] is True
 
 

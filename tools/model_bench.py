@@ -33,8 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-sys.path.insert(0, os.path.join(REPO, "examples", "imagenet"))
+for _p in (os.path.join(REPO, "examples", "imagenet"), REPO):
+    if _p not in sys.path:  # idempotent: bench.py imports this module too
+        sys.path.insert(0, _p)
 
 _PEAK_TFLOPS = 197.0  # v5e bf16
 
@@ -58,6 +59,9 @@ def _marginal_time(step, state, steps_n):
     return state, (t_2n - t_n) / steps_n, loss0, loss_end
 
 
+QUIET = False  # bench.py sets True when embedding results in its own lines
+
+
 def _report(name, batch, step_s, flops_per_step, unit_per_step, unit):
     per_sec = unit_per_step / step_s
     tflops = flops_per_step / step_s / 1e12
@@ -71,7 +75,8 @@ def _report(name, batch, step_s, flops_per_step, unit_per_step, unit):
         "mfu_hw": round(tflops / _PEAK_TFLOPS, 4),
         "flops_source": "xla_cost_analysis",
     }
-    print(json.dumps(out))
+    if not QUIET:
+        print(json.dumps(out))
     return out
 
 

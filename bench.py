@@ -1,11 +1,13 @@
 """Benchmark: training-step throughput on the available device(s).
 
-Prints one JSON line per captured config — flagship first, then (default
-run, deadline permitting) the GPT-1.3B, Llama-1B and ResNet-50 configs —
-and, when
-extras were captured, a FINAL combined line that repeats the flagship
-headline fields plus ``additional_configs: [...]`` holding every other
-captured result (so a last-line consumer records all of them):
+Prints the flagship's JSON line first, then (default run, deadline
+permitting) captures the GPT-1.3B, Llama-1B and ResNet-50 extras; after
+EVERY captured extra it emits a refreshed combined line repeating the
+flagship headline fields plus ``additional_configs: [...]`` with every
+extra captured so far.  Extras get no standalone lines, so the LAST
+complete line on stdout is ALWAYS a flagship-headlined record carrying
+all captured configs — no matter where an external timeout kills the
+process:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...,
    "additional_configs": [...]}
 
@@ -130,42 +132,62 @@ def _peak_tflops(device) -> float:
     return 197.0  # assume v5e-class
 
 
+# configs measured by tools/model_bench.py rather than a _CONFIGS card:
+# name -> (BENCHES key, default batch, config metadata for the record)
+_EXTERNAL_BENCHES = {
+    "resnet50": ("resnet50", 128,
+                 {"optimizer": "FusedSGD",
+                  "bn": "SyncBatchNorm(use_fast_variance=True)"}),
+}
+
+
+def _run_external(name: str, *, batch, steps, seq) -> dict:
+    """Capture a tools/model_bench.py row through the same retry/deadline
+    harness (the BASELINE.json primary vision metric rides in the round
+    record this way).  No MFU/0.45 ``vs_baseline`` — units differ."""
+    if seq:
+        raise ValueError(f"--seq does not apply to {name}")
+    bench_key, default_batch, meta = _EXTERNAL_BENCHES[name]
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools")
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import model_bench
+    was_quiet = model_bench.QUIET
+    model_bench.QUIET = True
+    try:
+        # steps floored at 8: at ~55 ms/step a shorter chain is dominated
+        # by a ~7 s tunnel-sync constant and the t(2N)>1.2*t(N) gate
+        # rejects the measurement (observed with --steps 4)
+        r = model_bench.BENCHES[bench_key](batch=batch or default_batch,
+                                           steps_n=max(steps or 8, 8))
+    finally:
+        model_bench.QUIET = was_quiet
+    dev = jax.devices()[0]
+    n_chips = jax.device_count()
+    # model_bench reports the whole-host rate; the metric is per-chip
+    r["value"] = round(r["value"] / n_chips, 1)
+    # recompute hw-MFU against THIS device's peak (model_bench's constant
+    # assumes v5e) so the line is self-consistent
+    r["mfu_hw"] = round(r["model_tflops_per_sec"] / n_chips
+                        / _peak_tflops(dev), 4)
+    if dev.platform == "tpu":
+        assert 0.0 < r["mfu_hw"] <= 1.0, (
+            f"measured hw-MFU {r['mfu_hw']} is not physical")
+    r["n_chips"] = n_chips
+    r["device"] = str(dev.device_kind)
+    r["config"] = {"model": name, "batch": r.pop("batch"), **meta}
+    return r
+
+
 def run_config(name: str, *, batch: int | None = None,
                steps: int | None = None, seq: int | None = None) -> dict:
     """Build everything from scratch, run the timing protocol, return the
     result dict.  Raises on any failure — the caller owns retry policy."""
     from apex_tpu.optimizers import FusedAdam, FusedLAMB
 
-    if name == "resnet50":
-        # the BASELINE.json primary vision metric, captured through the
-        # same retry/deadline harness (tools/model_bench.py does the
-        # measuring; no MFU/0.45 vs_baseline — its unit is imgs/s)
-        if seq:
-            raise ValueError("--seq does not apply to resnet50")
-        tools_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "tools")
-        if tools_dir not in sys.path:
-            sys.path.insert(0, tools_dir)
-        import model_bench
-        model_bench.QUIET = True
-        # steps floored at 8: at ~55 ms/step a shorter chain is dominated
-        # by a ~7 s tunnel-sync constant and the t(2N)>1.2*t(N) gate
-        # rejects the measurement (observed with --steps 4)
-        r = model_bench.bench_resnet50(batch=batch or 128,
-                                       steps_n=max(steps or 8, 8))
-        dev = jax.devices()[0]
-        # recompute hw-MFU against THIS device's peak (model_bench's
-        # constant assumes v5e) so the line is self-consistent
-        r["mfu_hw"] = round(r["model_tflops_per_sec"] / _peak_tflops(dev), 4)
-        if dev.platform == "tpu":
-            assert 0.0 < r["mfu_hw"] <= 1.0, (
-                f"measured hw-MFU {r['mfu_hw']} is not physical")
-        r["n_chips"] = jax.device_count()
-        r["device"] = str(dev.device_kind)
-        r["config"] = {"model": "resnet50", "batch": r.pop("batch"),
-                       "optimizer": "FusedSGD",
-                       "bn": "SyncBatchNorm(use_fast_variance=True)"}
-        return r
+    if name in _EXTERNAL_BENCHES:
+        return _run_external(name, batch=batch, steps=steps, seq=seq)
 
     cfg = dict(_CONFIGS[name])
     if batch:
@@ -412,19 +434,19 @@ def main(model: str | None, batch: int | None, steps: int | None,
         if r is not None:
             if extra_errors:
                 r["errors"] = extra_errors
-            print(json.dumps(r))
-            sys.stdout.flush()
             additional.append(r)
+            # emit a refreshed combined line after EVERY captured extra —
+            # and ONLY combined lines for extras: the last complete
+            # stdout line is then always a flagship-headlined record
+            # carrying every config captured so far, no matter where an
+            # external timeout kills the process
+            combined = dict(primary)
+            combined["additional_configs"] = additional
+            print(json.dumps(combined))
+            sys.stdout.flush()
         else:
             print(f"[bench] extra config {config} not captured: "
                   f"{extra_errors}", file=sys.stderr)
-
-    if additional:
-        # final combined line = flagship headline + every captured config,
-        # so a last-line consumer records all of them in one object
-        combined = dict(primary)
-        combined["additional_configs"] = additional
-        print(json.dumps(combined))
 
 
 def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
@@ -631,6 +653,8 @@ if __name__ == "__main__":
         # other sequence lengths — the fallback could then OOM too
         ap.error("--seq requires --model (the fallback chain keeps its "
                  "own tuned shapes)")
+    elif a.seq and a.model in _EXTERNAL_BENCHES:
+        ap.error(f"--seq does not apply to {a.model}")
     else:
         main(a.model, a.batch or None, a.steps or None, a.seq or None,
              attempts_per_config=a.attempts)

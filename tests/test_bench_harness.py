@@ -93,11 +93,13 @@ def test_default_run_captures_extra_configs(monkeypatch, no_sleep):
     monkeypatch.setattr(bench, "run_config", ok)
     lines = _tpu_lines(monkeypatch)
     assert calls == ["large", "1.3b", "llama-1b", "resnet50"]
-    # flagship line first, each extra as its own line, combined line last
-    assert [ln["metric"] for ln in lines[:4]] == [
-        "m_large", "m_1.3b", "m_llama-1b", "m_resnet50"]
+    # flagship line, then one refreshed combined line per captured extra —
+    # NO standalone extra lines, so a kill at ANY line boundary leaves a
+    # flagship-headlined record as the last complete line
+    assert [ln["metric"] for ln in lines] == ["m_large"] * 4
+    assert [len(ln.get("additional_configs", [])) for ln in lines] == [
+        0, 1, 2, 3]
     combined = lines[-1]
-    assert combined["metric"] == "m_large"
     assert [r["metric"] for r in combined["additional_configs"]] == [
         "m_1.3b", "m_llama-1b", "m_resnet50"]
 

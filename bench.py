@@ -138,6 +138,13 @@ _EXTERNAL_BENCHES = {
     "resnet50": ("resnet50", 128,
                  {"optimizer": "FusedSGD",
                   "bn": "SyncBatchNorm(use_fast_variance=True)"}),
+    # selectable via --model (not in the default extras chain — the
+    # deadline budget covers flagship + 3 extras); batches are the
+    # measured optima (PERF_NOTES r5 batch sweeps)
+    "vit-l16": ("vit-l16", 64, {"optimizer": "FusedAdam"}),
+    "bert-large": ("bert-large", 16,
+                   {"optimizer": "FusedLAMB", "state_dtype": "bfloat16",
+                    "seq": 512, "objective": "masked-LM + NSP"}),
 }
 
 
@@ -165,12 +172,12 @@ def _run_external(name: str, *, batch, steps, seq) -> dict:
         model_bench.QUIET = was_quiet
     dev = jax.devices()[0]
     n_chips = jax.device_count()
-    # model_bench reports the whole-host rate; the metric is per-chip
-    r["value"] = round(r["value"] / n_chips, 1)
+    # model_bench's plain-jit step executes on device 0 only, so its rate
+    # is already per-chip — no n_chips division (the *_per_chip metric
+    # name is correct as-is, regardless of how many chips the host shows)
     # recompute hw-MFU against THIS device's peak (model_bench's constant
     # assumes v5e) so the line is self-consistent
-    r["mfu_hw"] = round(r["model_tflops_per_sec"] / n_chips
-                        / _peak_tflops(dev), 4)
+    r["mfu_hw"] = round(r["model_tflops_per_sec"] / _peak_tflops(dev), 4)
     if dev.platform == "tpu":
         assert 0.0 < r["mfu_hw"] <= 1.0, (
             f"measured hw-MFU {r['mfu_hw']} is not physical")
@@ -607,7 +614,8 @@ def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--model",
-                    choices=sorted(_CONFIGS) + ["llama7b", "resnet50"],
+                    choices=sorted(_CONFIGS) + ["llama7b"]
+                    + sorted(_EXTERNAL_BENCHES),
                     default=None,
                     help="run ONE config (no fallback chain); default: "
                     "large with medium fallback.  'llama7b' is valid only "

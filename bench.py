@@ -305,7 +305,10 @@ def run_config(name: str, *, batch: int | None = None,
     flops_per_token = (6 * n_params
                        + 12 * cfg["layers"] * cfg["hidden"] * cfg["seq"] // 2)
     tflops = tokens_per_sec * flops_per_token / 1e12
-    peak = _peak_tflops(dev) * n_chips
+    # the plain-jit step executes on device 0 only, so the measured rate
+    # IS the per-chip rate: no n_chips scaling anywhere (matches the
+    # external model_bench rows; n_chips is recorded for information)
+    peak = _peak_tflops(dev)
     mfu = tflops / peak if on_tpu else 0.0
     if on_tpu:
         assert 0.0 < mfu <= 1.0, (
@@ -325,7 +328,7 @@ def run_config(name: str, *, batch: int | None = None,
         out_cfg["intermediate"] = cfg["intermediate"]
     return {
         "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec / n_chips, 1),
+        "value": round(tokens_per_sec, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4) if on_tpu else 0.0,
         "mfu": round(mfu, 4),

@@ -247,3 +247,30 @@ def test_fp16_utils_helpers(rng):
     assert back["w"].dtype == jnp.bfloat16
     g32 = model_grads_to_master_grads({"w": half["w"]})
     assert g32["w"].dtype == jnp.float32
+
+
+def test_fast_variance_matches_welford_and_clamps(rng):
+    """The one-pass local stats (use_fast_variance=True default, the r5
+    ResNet lever) must match the Welford-form stats in fp32 on realistic
+    activations, and the clamp must keep variance non-negative in the
+    cancellation-prone regime (huge mean, tiny variance) instead of
+    propagating a negative into rsqrt -> NaN."""
+    x = jnp.asarray(rng.normal(2.0, 3.0, (8, 16, 16, 32)), jnp.float32)
+    m_fast, v_fast, n_fast = sync_batch_stats(x, use_fast_variance=True)
+    m_ref, v_ref, n_ref = sync_batch_stats(x, use_fast_variance=False)
+    np.testing.assert_allclose(np.asarray(m_fast), np.asarray(m_ref),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(v_fast), np.asarray(v_ref),
+                               rtol=1e-4, atol=1e-5)
+    assert float(n_fast) == float(n_ref) == 8 * 16 * 16
+
+    # cancellation regime: mean ~1e4, std ~1e-2 -> E[x^2]-E[x]^2 is a
+    # difference of ~1e8 values; the clamp guarantees var >= 0 (the
+    # Welford path stays accurate here, which is why cross-rank merges
+    # always use it)
+    bad = jnp.asarray(1e4 + rng.normal(0.0, 1e-2, (4, 8, 8, 4)),
+                      jnp.float32)
+    _, v_bad, _ = sync_batch_stats(bad, use_fast_variance=True)
+    assert bool(jnp.all(v_bad >= 0.0)), "clamp must prevent negative var"
+    assert bool(jnp.all(jnp.isfinite(
+        jax.lax.rsqrt(v_bad + 1e-5)))), "rsqrt must stay finite"

@@ -18,6 +18,7 @@ import argparse
 import importlib
 import inspect
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -83,6 +84,12 @@ PAGES = {
 }
 
 
+# strip runtime memory addresses from default-value reprs (flax module
+# sentinels, function objects, dataclass auto-docstrings): regenerated
+# docs must be deterministic
+_ADDR_RE = re.compile(r" at 0x[0-9a-f]+")
+
+
 def _doc_first_block(obj) -> str:
     if inspect.isclass(obj) and vars(obj).get("__doc__") is None:
         # no own docstring: inspect.getdoc would return the (misleading)
@@ -90,17 +97,19 @@ def _doc_first_block(obj) -> str:
         try:
             mod = importlib.import_module(obj.__module__)
             doc = (mod.__doc__ or "").split("\n\n")[0].strip()
-            return doc
+            return _ADDR_RE.sub("", doc)
         except Exception:
             return ""
     doc = inspect.getdoc(obj) or ""
     block = doc.split("\n\n")[0].strip()
-    return block
+    # flax/dataclass auto-docstrings embed field-default reprs with
+    # runtime addresses — scrub for deterministic regeneration
+    return _ADDR_RE.sub("", block)
 
 
 def _sig(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        return _ADDR_RE.sub("", str(inspect.signature(obj)))
     except (ValueError, TypeError):
         return "(...)"
 
@@ -150,7 +159,7 @@ def _render_symbol(name: str, obj) -> list[str]:
         if d:
             lines.append(d + "\n")
     else:  # data export (e.g. enum instance, constant)
-        lines.append(f"### `{name}` = `{obj!r}`\n")
+        lines.append(f"### `{name}` = `{_ADDR_RE.sub('', repr(obj))}`\n")
     return lines
 
 

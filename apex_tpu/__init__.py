@@ -19,6 +19,8 @@ independently usable components, not a monolithic trainer:
 - :mod:`apex_tpu.contrib`        — flash attention, fused cross-entropy,
                                    group norm, sparsity, halo exchange, ZeRO
                                    optimizers, and other specialized ops.
+- :mod:`apex_tpu.resilience`     — validated atomic checkpointing, fault
+                                   injection, anomaly-aware step skipping.
 
 Unlike the reference there are no build-time extension flags: every component
 is pure JAX (Pallas kernels JIT-compile on TPU; jnp fallbacks run anywhere).
@@ -49,6 +51,7 @@ _SUBMODULES = (
     "transformer",
     "contrib",
     "ops",
+    "resilience",
     "utils",
     "feature_registry",
 )

@@ -9,7 +9,9 @@ record with distributed rank info (apex/__init__.py:31-43, pulling
 
 from __future__ import annotations
 
+import json
 import logging
+import time
 
 
 class RankInfoFilter(logging.Filter):
@@ -67,3 +69,20 @@ def set_logging_level(level: int | str) -> None:
 
 def get_logger(name: str) -> logging.Logger:
     return logging.getLogger(f"apex_tpu.{name}")
+
+
+def emit_event(kind: str, **fields) -> dict:
+    """Emit a structured (JSON) operational event and return it.
+
+    The resilience subsystem reports state transitions — checkpoint
+    saved/rejected/restored, step skipped, loss-scale floor halved —
+    as machine-parseable single-line events rather than prose, so a
+    fleet-level collector can alert on them (the reason silent recovery
+    loops are banned; see :mod:`apex_tpu.resilience`).  Events ride the
+    ordinary ``apex_tpu.events`` logger and therefore inherit the
+    rank-aware handler installed at import.
+    """
+    event = {"event": kind, "time": time.time(), **fields}
+    logging.getLogger("apex_tpu.events").info(
+        "%s", json.dumps(event, sort_keys=True, default=str))
+    return event

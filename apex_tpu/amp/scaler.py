@@ -14,12 +14,13 @@ same sync-free pattern as the reference's *capturable* FusedAdam
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, NamedTuple
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from apex_tpu.multi_tensor_apply import _nonfinite
+from apex_tpu.optimizers._common import unscale_grads
 
 
 class LossScalerState(NamedTuple):
@@ -75,12 +76,23 @@ class LossScaler:
         # Unscale in fp32 (shared helper): the reference unscales into fp32
         # master grads (scaler.py:105-118); dividing fp16 grads by 2^16 in
         # fp16 would flush to subnormals.
-        from apex_tpu.optimizers._common import unscale_grads
-
         return unscale_grads(grads, state.scale), found_inf
 
-    def update(self, state: LossScalerState, found_inf: jax.Array) -> LossScalerState:
-        """Post-step scale update (branch-free; csrc/update_scale_hysteresis.cu:5-45)."""
+    def update(
+        self,
+        state: LossScalerState,
+        found_inf: jax.Array,
+        *,
+        min_scale: Optional[jax.Array] = None,
+    ) -> LossScalerState:
+        """Post-step scale update (branch-free; csrc/update_scale_hysteresis.cu:5-45).
+
+        ``min_scale`` overrides the static ``min_loss_scale`` clamp with a
+        (possibly traced) dynamic floor — the hook
+        :func:`apex_tpu.resilience.guarded.guarded_update` uses to lower
+        the floor after sustained skipping instead of looping forever at a
+        scale that still overflows.
+        """
         if not self.dynamic:
             return state._replace(
                 unskipped=state.unskipped + jnp.where(found_inf, 0, 1).astype(jnp.int32)
@@ -94,9 +106,11 @@ class LossScaler:
                               jnp.maximum(state.hysteresis_tracker - 1, 0),
                               jnp.int32(self.hysteresis))
         backoff = jnp.logical_and(found_inf, hys_after <= 0)
+        floor = (jnp.float32(self.min_loss_scale) if min_scale is None
+                 else jnp.asarray(min_scale, jnp.float32))
         scale = jnp.where(
             backoff,
-            jnp.maximum(state.scale * self.backoff_factor, self.min_loss_scale),
+            jnp.maximum(state.scale * self.backoff_factor, floor),
             state.scale,
         )
         growth = jnp.where(found_inf, 0, state.growth_tracker + 1)

@@ -65,3 +65,5 @@ register("xentropy", "fused softmax cross-entropy with label smoothing", True)
 register("group_norm", "NHWC group norm (+swish)", True)
 register("sparsity", "2:4 structured sparsity (ASP)", False)
 register("halo_exchange", "spatial-parallel halo exchange", False, "ppermute")
+register("resilience", "validated checkpointing + fault injection + guarded stepping",
+         False, "host I/O + jnp")

@@ -114,6 +114,26 @@ class FusedOptimizer:
             return new_params, (new_inner, MasterState(new_work))
         return new_work, (new_inner, MasterState(None))
 
+    # -- checkpointing (optimizer.state_dict parity; README "Checkpointing") --
+    def state_dict(self, state: Any) -> dict:
+        """Host-side ``{leaf path: numpy array}`` of the full optimizer
+        state — moments, on-device step counter, fp32 masters.  Structure
+        lives in code (rebuild the optimizer, then ``load_state_dict``),
+        data lives in the dict; the resilience checkpoint layer persists
+        exactly this form with a validation manifest."""
+        from apex_tpu.utils.serialization import tree_to_host_dict
+
+        return tree_to_host_dict(state)
+
+    def load_state_dict(self, d: dict, like: Any) -> Any:
+        """Rebuild on-device optimizer state from :meth:`state_dict`
+        output.  ``like`` is a freshly built state (``init(params)``)
+        providing the pytree structure; shapes and dtypes are checked
+        strictly so a mismatched restore fails before training resumes."""
+        from apex_tpu.utils.serialization import tree_from_host_dict
+
+        return tree_from_host_dict(d, like)
+
     def as_optax(self) -> optax.GradientTransformation:
         """Expose as an optax transform producing *updates* (param deltas)."""
 

@@ -1,0 +1,93 @@
+"""apex_tpu.resilience — survive preemption, corruption, and blow-ups.
+
+The reference threads recoverable state everywhere (fp32 masters, the
+scaler's ``unskipped`` checkpoint-parity counter, per-rank RNG trackers)
+but leaves actual recovery to the consumer.  At TPU-pod scale preemptions
+and transient numerical blow-ups are routine (PAPERS.md: "Exploring the
+limits of Concurrency in ML Training on Google TPUs", "Scale MLPerf-0.6
+models on Google TPU-v3 Pods"), so this subsystem makes the full loop —
+kill, corrupt, restart, converge — a tested code path:
+
+- :mod:`.checkpoint` — validated atomic checkpoints of arbitrary pytrees
+  (params, optimizer state, ``LossScalerState``, RNG keys, step counter):
+  shape/dtype/CRC manifest, write-temp + atomic rename, keep-last-K
+  rotation, automatic fallback to the newest checkpoint that validates.
+- :mod:`.fault_injection` — deterministic seed-driven faults: NaN/Inf
+  gradients at a chosen step, simulated preemption at the host step
+  boundary, checkpoint byte corruption/truncation on disk.
+- :mod:`.guarded` — anomaly-aware stepping on top of
+  :mod:`apex_tpu.amp.scaler`: per-leaf non-finite localization, a
+  consecutive-skip counter, and bounded degradation (halve the dynamic
+  loss-scale floor after ``patience`` skips, with a structured event)
+  instead of a silent infinite skip loop.
+
+End-to-end recipe (the shape tier-1's preemption/corruption test runs)::
+
+    from apex_tpu import resilience as rz
+
+    mgr = rz.CheckpointManager("/ckpts/run7", keep=3)
+    scaler = LossScaler(); sstate = scaler.init()
+    gstate = rz.init_guard_state(scaler)
+    step = jax.jit(rz.make_guarded_step(loss_fn, opt, scaler))
+
+    state = {"params": params, "opt": opt_state, "scaler": sstate,
+             "guard": gstate, "rng": rng}
+    try:
+        restored, start = mgr.restore(like=state)   # newest VALID ckpt
+        state, start = restored, start + 1
+    except rz.CheckpointError:
+        start = 0                                   # fresh run
+    for i in range(start, num_steps):
+        injector.check_preemption(i)                # tests only
+        out = step(state["params"], state["opt"], state["scaler"],
+                   state["guard"], batch(state["rng"], i))
+        state = dict(zip(("params", "opt", "scaler", "guard"), out[:4]),
+                     rng=state["rng"])
+        mgr.save(i, state)
+
+A checkpoint root assumes a single writer — in multi-controller runs
+gate ``mgr.save`` on ``jax.process_index() == 0`` (or give each process
+its own root); concurrent saves into one root race the temp-dir sweep.
+"""
+
+from apex_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    latest_valid_step,
+    restore_checkpoint,
+    save_checkpoint,
+    validate_checkpoint,
+)
+from apex_tpu.resilience.fault_injection import (
+    FaultInjector,
+    FaultPlan,
+    SimulatedPreemption,
+)
+from apex_tpu.resilience.guarded import (
+    GuardConfig,
+    GuardState,
+    guarded_update,
+    init_guard_state,
+    make_guarded_step,
+    nonfinite_counts,
+    nonfinite_report,
+)
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointManager",
+    "latest_valid_step",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "validate_checkpoint",
+    "FaultInjector",
+    "FaultPlan",
+    "SimulatedPreemption",
+    "GuardConfig",
+    "GuardState",
+    "guarded_update",
+    "init_guard_state",
+    "make_guarded_step",
+    "nonfinite_counts",
+    "nonfinite_report",
+]

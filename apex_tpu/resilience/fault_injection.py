@@ -1,0 +1,159 @@
+"""Deterministic, seed-driven fault injection for training loops.
+
+Every recovery path in :mod:`apex_tpu.resilience` is exercised by tier-1
+tests instead of being discovered in production — which requires faults
+that are *reproducible*: the same :class:`FaultPlan` seed produces the
+same corrupted gradient elements, the same preemption step, and the same
+flipped checkpoint bytes on every run.
+
+Three fault classes, matching what pod-scale training actually sees
+(PAPERS.md TPU-pod papers; ROADMAP north-star):
+
+- **Numerical**: :meth:`FaultInjector.inject_grads` flips chosen gradient
+  elements to NaN/Inf at configured steps.  jit-safe — the injection is a
+  branch-free ``jnp.where`` on the on-device step counter, so it composes
+  with the capturable train step exactly like a real overflow would.
+- **Preemption**: :meth:`FaultInjector.check_preemption` raises
+  :class:`SimulatedPreemption` at the configured step from the host-side
+  step boundary — the point where a real SIGTERM lands, after the device
+  step was dispatched but before the host commits/extends its state.
+- **Storage**: :meth:`FaultInjector.corrupt_checkpoint` /
+  :meth:`truncate_checkpoint` damage checkpoint bytes on disk the way a
+  preempted writer or a bad disk does, to drive the validation-fallback
+  path of :mod:`apex_tpu.resilience.checkpoint`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu._logging import emit_event
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "SimulatedPreemption",
+]
+
+
+class SimulatedPreemption(RuntimeError):
+    """Raised at an injected preemption boundary (stands in for SIGTERM)."""
+
+    def __init__(self, step: int):
+        super().__init__(f"simulated preemption at step {step}")
+        self.step = step
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """What to break, and when (all step indices are host step numbers).
+
+    ``nan_grad_steps`` / ``inf_grad_steps``: steps whose gradients get
+    deterministic NaN / Inf elements injected.  ``preempt_steps``: steps
+    whose host boundary raises :class:`SimulatedPreemption`.  ``seed``
+    drives every placement choice.
+    """
+
+    seed: int = 0
+    nan_grad_steps: Tuple[int, ...] = ()
+    inf_grad_steps: Tuple[int, ...] = ()
+    preempt_steps: Tuple[int, ...] = ()
+
+
+class FaultInjector:
+    """Executes a :class:`FaultPlan` against a training loop."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    # -- numerical faults (jit-safe) --------------------------------------
+
+    def inject_grads(self, grads: Any, step: jax.Array) -> Any:
+        """Return ``grads`` with NaN/Inf planted when ``step`` is a
+        configured fault step; a no-op (same values) otherwise.
+
+        jit-safe: ``step`` may be a traced on-device scalar.  The target
+        leaf and element are chosen deterministically from the seed at
+        trace time, so recompilation cannot move the fault.
+        """
+        plan = self.plan
+        if not plan.nan_grad_steps and not plan.inf_grad_steps:
+            return grads
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        # a fault can only live in a non-empty floating-point leaf
+        candidates = [i for i, l in enumerate(leaves)
+                      if l.size and jnp.issubdtype(l.dtype, jnp.inexact)]
+        if not candidates:
+            return grads
+        rng = np.random.default_rng(plan.seed)
+        step = jnp.asarray(step, jnp.int32)
+        # the fault is planted in the leaf's OWN dtype (every float dtype
+        # has nan/inf), so off-step execution is bit-identical — no
+        # precision roundtrip that would desync a clean-vs-faulted
+        # trajectory comparison
+        for bad, steps in ((jnp.nan, plan.nan_grad_steps),
+                           (jnp.inf, plan.inf_grad_steps)):
+            # consume the seed stream even for unconfigured classes so a
+            # plan's nan/inf placements do not depend on each other
+            idx = candidates[int(rng.integers(len(candidates)))]
+            leaf = leaves[idx]
+            pos = int(rng.integers(leaf.size))
+            if not steps:
+                continue
+            is_hit = jnp.any(step == jnp.asarray(steps, jnp.int32))
+            flat = jnp.ravel(leaf)
+            flat = flat.at[pos].set(
+                jnp.where(is_hit, jnp.asarray(bad, leaf.dtype), flat[pos]))
+            leaves[idx] = flat.reshape(leaf.shape)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- preemption (host boundary) ---------------------------------------
+
+    def check_preemption(self, step: int) -> None:
+        """Host-side step boundary: raises :class:`SimulatedPreemption`
+        when ``step`` is a configured preemption step.
+
+        Call it where a SIGTERM handler would fire — after dispatching the
+        device step, before committing host-side state (checkpoint index,
+        data-loader cursor).  The device computation in flight is simply
+        abandoned, exactly as a real preemption abandons it.
+        """
+        if int(step) in self.plan.preempt_steps:
+            emit_event("fault_injected", fault="preemption", step=int(step))
+            raise SimulatedPreemption(int(step))
+
+    # -- storage faults ----------------------------------------------------
+
+    def corrupt_checkpoint(self, ckpt_dir: str, *, nbytes: int = 8) -> list[int]:
+        """Flip ``nbytes`` seed-chosen bytes of ``<ckpt_dir>/data.bin``
+        in place; returns the corrupted offsets (bit corruption)."""
+        path = os.path.join(ckpt_dir, "data.bin")
+        size = os.path.getsize(path)
+        rng = np.random.default_rng(self.plan.seed)
+        offsets = sorted(
+            int(o) for o in rng.choice(size, size=min(nbytes, size),
+                                       replace=False))
+        with open(path, "r+b") as f:
+            for off in offsets:
+                f.seek(off)
+                byte = f.read(1)[0]
+                f.seek(off)
+                f.write(bytes([byte ^ 0xFF]))
+        emit_event("fault_injected", fault="checkpoint_corruption",
+                   path=path, offsets=offsets)
+        return offsets
+
+    def truncate_checkpoint(self, ckpt_dir: str, *, drop_bytes: int = 1) -> None:
+        """Truncate ``data.bin`` by ``drop_bytes`` (half-written writer)."""
+        path = os.path.join(ckpt_dir, "data.bin")
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size - drop_bytes, 0))
+        emit_event("fault_injected", fault="checkpoint_truncation",
+                   path=path, dropped=drop_bytes)

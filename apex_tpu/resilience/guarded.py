@@ -1,0 +1,223 @@
+"""Anomaly-aware stepping: localize, skip, degrade — never loop silently.
+
+Layered on :mod:`apex_tpu.amp.scaler`: the capturable train step already
+skips the optimizer update on overflow (``found_inf`` + ``jnp.where``),
+but a bare skip loop has two production failure modes this module closes:
+
+1. **No localization.**  The global ``found_inf`` bit says *that* a step
+   overflowed, not *where*.  :func:`nonfinite_counts` is the jit-safe
+   per-leaf census (count of NaN/Inf elements per gradient leaf);
+   :func:`nonfinite_report` renders it as ``{leaf path: count}`` on the
+   host — the difference between "step 4017 overflowed" and "step 4017
+   overflowed in ``layers_12/attn/out_proj`` only".
+2. **No escape hatch.**  If the loss scale backs off to its floor and
+   gradients *still* blow up (a real divergence, not scale-induced
+   overflow), ``update`` skips forever.  :func:`guarded_update` keeps a
+   consecutive-skip counter in :class:`GuardState`; after ``patience``
+   consecutive skips it halves the dynamic scale floor (letting backoff
+   continue below the configured ``min_loss_scale``) and emits a
+   structured ``loss_scale_floor_halved`` event through
+   :func:`apex_tpu._logging.emit_event` — degradation is visible and
+   bounded instead of silent and infinite.
+
+Everything here is jit-safe; the event emission crosses to the host
+through ``jax.debug.callback``, which is the supported effect boundary
+under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._logging import emit_event
+from apex_tpu.amp.scaler import LossScaler, LossScalerState
+from apex_tpu.utils.serialization import tree_paths
+
+__all__ = [
+    "GuardConfig",
+    "GuardState",
+    "guarded_update",
+    "init_guard_state",
+    "make_guarded_step",
+    "nonfinite_counts",
+    "nonfinite_report",
+]
+
+
+class GuardState(NamedTuple):
+    """Device-resident skip bookkeeping (jit-safe scalars, checkpointable
+    alongside :class:`LossScalerState`)."""
+
+    consecutive_skips: jax.Array  # i32 current skip run length
+    total_skips: jax.Array  # i32 lifetime skipped steps
+    scale_floor: jax.Array  # f32 dynamic min_loss_scale (halves on trip)
+
+
+@dataclasses.dataclass(frozen=True)
+class GuardConfig:
+    """``patience``: consecutive skips tolerated before degrading.
+    ``floor_backoff``: factor applied to the dynamic floor on each trip.
+    ``min_floor``: hard lower bound — below this the run is diverging and
+    no loss scale can save it (events keep firing so the operator sees)."""
+
+    patience: int = 8
+    floor_backoff: float = 0.5
+    min_floor: float = 2.0**-14
+
+    def __post_init__(self):
+        # patience=0 would make the trip condition (consec >= patience)
+        # true on CLEAN steps and silently destroy loss scaling
+        if self.patience < 1:
+            raise ValueError(f"patience must be >= 1, got {self.patience}")
+        if not 0.0 < self.floor_backoff <= 1.0:
+            raise ValueError(
+                f"floor_backoff must be in (0, 1], got {self.floor_backoff}")
+        if self.min_floor <= 0.0:
+            raise ValueError(
+                f"min_floor must be positive, got {self.min_floor}")
+
+
+def init_guard_state(scaler: LossScaler) -> GuardState:
+    """Zeroed counters; the dynamic floor starts at the scaler's
+    configured ``min_loss_scale``."""
+    return GuardState(
+        consecutive_skips=jnp.int32(0),
+        total_skips=jnp.int32(0),
+        scale_floor=jnp.float32(scaler.min_loss_scale),
+    )
+
+
+def nonfinite_counts(grads: Any) -> Any:
+    """Per-leaf count of non-finite elements (i32 scalars; jit-safe).
+
+    This is the localizing refinement of the global overflow bit computed
+    by ``multi_tensor_apply._nonfinite``: same traversal, but the result
+    keeps the pytree structure instead of OR-reducing it away.
+    """
+    return jax.tree.map(
+        lambda g: jnp.sum(
+            ~jnp.isfinite(g.astype(jnp.float32))).astype(jnp.int32), grads)
+
+
+def nonfinite_report(counts: Any) -> dict[str, int]:
+    """Host-side ``{leaf path: nonfinite count}`` for the offending leaves
+    only (empty dict == clean step).  Feed it ``nonfinite_counts`` output
+    after the step has been fetched — not inside jit."""
+    flat_paths = tree_paths(counts)
+    leaves = jax.tree.leaves(counts)
+    return {p: int(c) for p, c in zip(flat_paths, leaves) if int(c)}
+
+
+def _emit_floor_event(scale, floor, consec, total) -> None:
+    emit_event(
+        "loss_scale_floor_halved",
+        scale=float(scale), new_floor=float(floor),
+        consecutive_skips=int(consec), total_skips=int(total))
+
+
+def guarded_update(
+    scaler: LossScaler,
+    state: LossScalerState,
+    guard: GuardState,
+    found_inf: jax.Array,
+    config: GuardConfig = GuardConfig(),
+) -> Tuple[LossScalerState, GuardState]:
+    """``scaler.update`` plus skip accounting and bounded degradation.
+
+    Branch-free device math: the consecutive-skip counter increments on
+    overflow and resets on clean steps; when it reaches ``patience`` the
+    dynamic floor halves (clamped at ``min_floor``), the counter resets
+    to give the lowered floor a fresh window, and a structured event is
+    emitted from the host boundary.
+    """
+    found_inf = jnp.asarray(found_inf).astype(jnp.bool_)
+    consec = jnp.where(found_inf, guard.consecutive_skips + 1, 0)
+    tripped = consec >= config.patience
+    new_floor = jnp.where(
+        tripped,
+        jnp.maximum(guard.scale_floor * config.floor_backoff,
+                    config.min_floor),
+        guard.scale_floor,
+    ).astype(jnp.float32)
+    new_state = scaler.update(state, found_inf, min_scale=new_floor)
+    # The trip forces a backoff even when hysteresis had not burnt through
+    # yet — patience expiring IS the stronger signal that the current
+    # scale cannot work.  Forced only when update() did NOT already back
+    # off this step, so a trip step always drops the scale exactly once
+    # (never backoff_factor**2) — and never for a static scaler, whose
+    # contract is that the scale does not move at all.
+    if scaler.dynamic:
+        already_backed = new_state.scale < state.scale
+        forced = jnp.maximum(
+            state.scale * jnp.float32(scaler.backoff_factor), new_floor)
+        new_state = new_state._replace(
+            scale=jnp.where(jnp.logical_and(tripped, ~already_backed),
+                            forced, new_state.scale))
+    new_guard = GuardState(
+        consecutive_skips=jnp.where(tripped, 0, consec).astype(jnp.int32),
+        total_skips=(guard.total_skips
+                     + found_inf.astype(jnp.int32)),
+        scale_floor=new_floor,
+    )
+    # host effect only on actual trips (lax.cond gates the callback), so
+    # the common clean/skip path pays no per-step device->host transfer
+    jax.lax.cond(
+        tripped,
+        lambda s, fl, c, t: jax.debug.callback(_emit_floor_event,
+                                               s, fl, c, t),
+        lambda s, fl, c, t: None,
+        new_state.scale, new_floor, consec, new_guard.total_skips)
+    return new_state, new_guard
+
+
+def make_guarded_step(
+    loss_fn: Callable[[Any, Any], jax.Array],
+    optimizer,
+    scaler: LossScaler,
+    config: GuardConfig = GuardConfig(),
+) -> Callable:
+    """Build the jit-safe guarded train step.
+
+    ``loss_fn(params, batch) -> scalar``; ``optimizer`` is any
+    :class:`~apex_tpu.optimizers.FusedOptimizer`.  The returned function
+
+    ``step(params, opt_state, sstate, gstate, batch)
+        -> (params, opt_state, sstate, gstate, metrics)``
+
+    scales the loss, localizes non-finite gradients per leaf, applies the
+    capturable skip, and runs :func:`guarded_update`.  ``metrics`` is a
+    dict of on-device scalars plus the per-leaf ``nonfinite`` census —
+    pass the census to :func:`nonfinite_report` after fetching to name
+    the offending parameters.
+    """
+
+    def step(params, opt_state, sstate: LossScalerState, gstate: GuardState,
+             batch):
+        def scaled(p):
+            loss = loss_fn(p, batch)
+            return scaler.scale_loss(loss, sstate), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(params)
+        grads, found_inf = scaler.unscale(grads, sstate)
+        counts = nonfinite_counts(grads)
+        new_params, new_opt_state = optimizer.step(
+            grads, params, opt_state, found_inf=found_inf)
+        new_sstate, new_gstate = guarded_update(
+            scaler, sstate, gstate, found_inf, config)
+        metrics = {
+            "loss": loss,
+            "found_inf": found_inf,
+            "skipped": found_inf,
+            "scale": new_sstate.scale,
+            "scale_floor": new_gstate.scale_floor,
+            "consecutive_skips": new_gstate.consecutive_skips,
+            "total_skips": new_gstate.total_skips,
+            "nonfinite": counts,
+        }
+        return new_params, new_opt_state, new_sstate, new_gstate, metrics
+
+    return step

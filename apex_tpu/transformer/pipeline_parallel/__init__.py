@@ -6,6 +6,7 @@ no-pipelining / 1F1B / interleaved schedules, microbatch utils, timers.
 
 from apex_tpu.transformer.pipeline_parallel.schedules import (
     PipelineStageSpec,
+    accumulated_found_inf,
     build_model,
     forward_backward_no_pipelining,
     forward_backward_pipelining_1f1b,
@@ -24,6 +25,7 @@ from apex_tpu.transformer.pipeline_parallel.utils import (
 
 __all__ = [
     "PipelineStageSpec",
+    "accumulated_found_inf",
     "build_model",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_1f1b",

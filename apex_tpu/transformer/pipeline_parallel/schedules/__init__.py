@@ -2,6 +2,7 @@
 
 from apex_tpu.transformer.pipeline_parallel.schedules.common import (
     PipelineStageSpec,
+    accumulated_found_inf,
     build_model,
 )
 from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_1f1b import (
@@ -23,6 +24,7 @@ from apex_tpu.transformer.pipeline_parallel.schedules.fwd_bwd_pipelining_without
 
 __all__ = [
     "PipelineStageSpec",
+    "accumulated_found_inf",
     "build_model",
     "forward_backward_no_pipelining",
     "forward_backward_pipelining_1f1b",

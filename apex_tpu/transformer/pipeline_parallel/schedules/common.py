@@ -24,7 +24,8 @@ from typing import Any, Callable, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["PipelineStageSpec", "build_model", "listify_model"]
+__all__ = ["PipelineStageSpec", "accumulated_found_inf", "build_model",
+           "listify_model"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,3 +85,28 @@ def build_model(
 def _masked_mean(values: jax.Array, mask: jax.Array) -> jax.Array:
     denom = jnp.maximum(jnp.sum(mask), 1.0)
     return jnp.sum(values * mask) / denom
+
+
+def accumulated_found_inf(grads: Any, *, axis_name: Optional[str] = None) -> jax.Array:
+    """Step-level overflow flag for microbatch-accumulated gradients.
+
+    Skip semantics must be *consistent across microbatches*: either every
+    microbatch of a step contributes to the update, or none does — a
+    per-microbatch skip would silently change the effective batch and the
+    grad-accumulation denominator.  All schedules here accumulate grads by
+    summation, and non-finite values are absorbing under IEEE addition
+    (``inf + x = inf``, ``inf - inf = nan``, ``nan + x = nan``), so ONE
+    overflow check on the summed grads is exactly the OR over microbatch
+    checks — the same all-or-nothing contract the reference enforces by
+    sharing one ``noop_flag`` buffer across the whole accumulation window.
+
+    For pipeline schedules the per-rank grads see only this rank's stage
+    params; pass ``axis_name`` to OR the flag across pipeline ranks so
+    every rank skips (or applies) the same step.
+    """
+    from apex_tpu.multi_tensor_apply import _nonfinite
+
+    flag = _nonfinite(grads)
+    if axis_name is not None:
+        flag = jax.lax.pmax(flag.astype(jnp.int32), axis_name) > 0
+    return flag

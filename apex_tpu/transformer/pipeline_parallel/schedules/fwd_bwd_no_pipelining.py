@@ -17,6 +17,10 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from apex_tpu.transformer.pipeline_parallel.schedules.common import (
+    accumulated_found_inf,
+)
+
 __all__ = ["forward_backward_no_pipelining"]
 
 
@@ -28,7 +32,8 @@ def forward_backward_no_pipelining(
     forward_only: bool = False,
     grad_scaler=None,
     scaler_state=None,
-) -> Tuple[jax.Array, Optional[Any]]:
+    with_found_inf: bool = False,
+) -> "Tuple[jax.Array, Optional[Any]] | Tuple[jax.Array, Optional[Any], jax.Array]":
     """Returns (mean_loss, summed_grads or None).
 
     ``loss_fn(params, microbatch) -> scalar``; ``microbatches`` is a pytree
@@ -36,6 +41,15 @@ def forward_backward_no_pipelining(
     ``grad_scaler`` is given, each microbatch loss is scaled before backward
     (common.py:253-420 semantics) and the returned grads are still *scaled*
     (unscale with the scaler, as the reference's trainer does).
+
+    ``with_found_inf=True`` additionally returns the step-level overflow
+    flag: ``(mean_loss, grads, found_inf)``.  Skip semantics are
+    all-or-nothing at step granularity — one overflowing microbatch marks
+    the whole accumulated step skipped.  The flag is ONE check on the
+    summed grads, which is exactly the OR over per-microbatch checks
+    because non-finite values are absorbing under the scan's summation
+    (see :func:`..schedules.common.accumulated_found_inf`); the resilience
+    guarded step is the consumer side.
     """
     n_micro = jax.tree.leaves(microbatches)[0].shape[0]
 
@@ -51,6 +65,8 @@ def forward_backward_no_pipelining(
             return acc + loss, None
 
         total, _ = jax.lax.scan(fwd_body, jnp.zeros((), jnp.float32), microbatches)
+        if with_found_inf:
+            return total / n_micro, None, jnp.zeros((), jnp.bool_)
         return total / n_micro, None
 
     grad_fn = jax.grad(scaled_loss, has_aux=True)
@@ -64,4 +80,6 @@ def forward_backward_no_pipelining(
     zero_grads = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
     (total_loss, grads), _ = jax.lax.scan(
         body, (jnp.zeros((), jnp.float32), zero_grads), microbatches)
+    if with_found_inf:
+        return total_loss / n_micro, grads, accumulated_found_inf(grads)
     return total_loss / n_micro, grads

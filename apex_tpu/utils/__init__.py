@@ -1,4 +1,5 @@
-"""Shared utilities: pytree flatten/packing, dtype helpers, tree math."""
+"""Shared utilities: pytree flatten/packing, dtype helpers, tree math,
+host-side pytree serialization."""
 
 from apex_tpu.utils.packing import (
     flatten_dense_tensors,
@@ -6,6 +7,12 @@ from apex_tpu.utils.packing import (
     PackedBuffer,
     pack_pytree,
     unpack_pytree,
+)
+from apex_tpu.utils.serialization import (
+    leaf_crc32,
+    tree_from_host_dict,
+    tree_paths,
+    tree_to_host_dict,
 )
 from apex_tpu.utils.tree_math import (
     tree_add,
@@ -17,6 +24,10 @@ from apex_tpu.utils.tree_math import (
 )
 
 __all__ = [
+    "leaf_crc32",
+    "tree_from_host_dict",
+    "tree_paths",
+    "tree_to_host_dict",
     "flatten_dense_tensors",
     "unflatten_dense_tensors",
     "PackedBuffer",

@@ -23,13 +23,17 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compilation cache: the suite is compile-dominated (tiny models,
-# big shard_map graphs); caching jit artifacts across runs cuts wall time
-# from >13 min to the actual execution cost.
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(__file__), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# NO persistent compilation cache.  The warm-cache snapshot this suite
+# used to ship (tests/.jax_cache, wired here with min_compile_time 0.5 s)
+# is a correctness hazard on jaxlib 0.4.37 CPU: deserializing a cached
+# executable — including one written moments earlier by the SAME suite
+# process — nondeterministically dies with SIGSEGV/SIGABRT inside XLA
+# (reproduced on the DDP ResNet train_step of test_convergence_l1, which
+# aborted the entire tier-1 run at file 7/41 on this host).  A compile
+# cache that can kill the process is worse than cold compiles; the
+# resilience PR removed it.  If a future jaxlib fixes executable
+# deserialization, re-enable via jax_compilation_cache_dir here and
+# re-commit a snapshot built on the SAME host image.
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -193,6 +193,31 @@ def test_deadline_stops_new_attempts(monkeypatch, no_sleep):
     assert any("deadline" in e for e in result["errors"])
 
 
+def test_recovery_metrics_block():
+    """The resilience-overhead block (ISSUE 1 satellite): checkpoint
+    save/validate/restore timings + bytes, with leaf sampling under a
+    byte budget so TPU-size states can't blow the bench deadline."""
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.ones((64, 64), jnp.float32),
+            "b": jnp.ones((128,), jnp.bfloat16)}
+    r = bench._recovery_metrics(tree)
+    assert r["bytes"] == 64 * 64 * 4 + 128 * 2
+    assert r["sampled"] is False
+    assert r["n_leaves"] == 2
+    for k in ("save_ms", "validate_ms", "restore_ms"):
+        assert r[k] >= 0.0
+    # budget smaller than the tree: sampling kicks in but never to zero
+    r2 = bench._recovery_metrics(tree, byte_budget=16)
+    assert r2["sampled"] is True and r2["n_leaves"] == 1
+    # a FIRST leaf bigger than the whole budget is sliced, not taken
+    # whole — the budget is a hard cap (code-review finding)
+    r3 = bench._recovery_metrics({"big": jnp.ones((64, 64), jnp.float32)},
+                                 byte_budget=256)
+    assert r3["sampled"] is True
+    assert r3["bytes"] <= 256
+
+
 def test_cpu_smoke_end_to_end(monkeypatch):
     """The real measurement path on the real (CPU) backend.
 

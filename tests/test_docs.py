@@ -8,7 +8,7 @@ REPO = Path(__file__).resolve().parent.parent
 DOCS = REPO / "docs"
 
 PAGES = ["amp", "optimizers", "parallel", "transformer", "normalization",
-         "layers", "ops", "models", "contrib", "utils"]
+         "layers", "ops", "models", "contrib", "resilience", "utils"]
 
 # page -> symbols a user would look up there (spot checks that the
 # generator actually rendered the module contents, not empty shells)
@@ -25,6 +25,11 @@ MUST_MENTION = {
     "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline",
                "vit_l16", "llama2_7b"],
     "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
+    # the prologue (checkpoint format / recovery semantics) plus the
+    # introspected API must both be present
+    "resilience": ["CheckpointManager", "FaultInjector", "make_guarded_step",
+                   "manifest.json", "crc32", "SimulatedPreemption"],
+    "utils": ["tree_to_host_dict", "emit_event"],
 }
 
 

@@ -1,0 +1,782 @@
+"""Training-resilience subsystem tests.
+
+Every recovery path is exercised here rather than discovered in
+production (ISSUE 1 tentpole): validated atomic checkpointing with
+corruption fallback, deterministic fault injection, anomaly-aware
+guarded stepping, cross-microbatch skip consistency, state round-trips
+for every amp/optimizer state type, and the end-to-end acceptance run —
+kill mid-run, corrupt the newest checkpoint, restart, resume
+bit-identically.
+"""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, resilience as rz
+from apex_tpu._logging import emit_event
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers import (
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedMixedPrecisionLamb,
+    FusedNovoGrad,
+    FusedSGD,
+)
+from apex_tpu.resilience.checkpoint import _TMP_PREFIX
+
+
+def _tree_equal(a, b):
+    from apex_tpu.utils.serialization import leaf_to_numpy
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = leaf_to_numpy(x), leaf_to_numpy(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+
+def _state_tree(seed=0):
+    """A representative train-state pytree: mixed dtypes, NamedTuple
+    optimizer state, scaler state, old- and new-style RNG keys, counter."""
+    params = {"w": jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4),
+              "b": jnp.ones((4,), jnp.float32) * seed}
+    opt = FusedAdam(lr=1e-2, master_weights=True)
+    scaler = LossScaler()
+    return {
+        "params": params,
+        "opt": opt.init(params),
+        "scaler": scaler.init(),
+        "guard": rz.init_guard_state(scaler),
+        "rng_old": jax.random.PRNGKey(seed),
+        "rng_typed": jax.random.key(seed),
+        "step": jnp.int32(seed),
+    }
+
+
+# --------------------------------------------------------------------------
+# validated atomic checkpointing
+# --------------------------------------------------------------------------
+
+class TestCheckpoint:
+    def test_roundtrip_bit_identical(self, tmp_path):
+        tree = _state_tree(3)
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(7, tree)
+        restored, step = mgr.restore(like=_state_tree(0))
+        assert step == 7
+        _tree_equal(tree, restored)
+
+    def test_rotation_keeps_last_k(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=2)
+        tree = _state_tree()
+        for s in range(5):
+            mgr.save(s, tree)
+        assert mgr.all_steps() == [3, 4]
+
+    def test_no_temp_litter_after_save(self, tmp_path):
+        rz.save_checkpoint(str(tmp_path), 1, _state_tree())
+        leftovers = [n for n in os.listdir(tmp_path)
+                     if n.startswith(_TMP_PREFIX)]
+        assert leftovers == []
+
+    def test_corruption_detected_and_skipped(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        tree = _state_tree()
+        for s in range(3):
+            mgr.save(s, tree)
+        rz.FaultInjector(rz.FaultPlan(seed=5)).corrupt_checkpoint(
+            mgr.checkpoint_path(2))
+        with pytest.raises(rz.CheckpointError, match="CRC"):
+            rz.validate_checkpoint(mgr.checkpoint_path(2))
+        assert mgr.latest_valid_step() == 1
+        _, step = mgr.restore(like=_state_tree())
+        assert step == 1
+
+    def test_truncation_detected_and_skipped(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        tree = _state_tree()
+        for s in range(2):
+            mgr.save(s, tree)
+        rz.FaultInjector(rz.FaultPlan()).truncate_checkpoint(
+            mgr.checkpoint_path(1), drop_bytes=3)
+        with pytest.raises(rz.CheckpointError, match="truncated"):
+            rz.validate_checkpoint(mgr.checkpoint_path(1))
+        _, step = mgr.restore(like=_state_tree())
+        assert step == 0
+
+    def test_corrupt_but_parsable_manifest_falls_back(self, tmp_path):
+        """Bit corruption in manifest.json itself (still valid JSON) must
+        surface as CheckpointError and fall back — never escape as a
+        ValueError that aborts the restore walk (code-review finding)."""
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        tree = _state_tree()
+        for s in range(2):
+            mgr.save(s, tree)
+        mpath = os.path.join(mgr.checkpoint_path(1), "manifest.json")
+        with open(mpath) as f:
+            manifest = json.load(f)
+        manifest["leaves"][0]["nbytes"] -= 1  # size-consistent lie
+        manifest["data_nbytes"] -= 1
+        blob = json.dumps(manifest)
+        with open(os.path.join(mgr.checkpoint_path(1), "data.bin"),
+                  "r+b") as f:
+            f.truncate(manifest["data_nbytes"])
+        with open(mpath, "w") as f:
+            f.write(blob)
+        _, step = mgr.restore(like=_state_tree())
+        assert step == 0
+        # non-dict manifest: also a clean rejection
+        with open(mpath, "w") as f:
+            f.write("[1, 2, 3]")
+        _, step = mgr.restore(like=_state_tree())
+        assert step == 0
+
+    def test_resave_existing_step_stays_valid(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        tree = _state_tree(1)
+        mgr.save(5, tree)
+        mgr.save(5, _state_tree(2))  # replace in place
+        restored, step = mgr.restore(like=_state_tree(0))
+        assert step == 5
+        _tree_equal(restored, _state_tree(2))
+        leftovers = [n for n in os.listdir(tmp_path)
+                     if n.startswith(_TMP_PREFIX)]
+        assert leftovers == []
+
+    def test_unreadable_manifest_skipped(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        tree = _state_tree()
+        for s in range(2):
+            mgr.save(s, tree)
+        with open(os.path.join(mgr.checkpoint_path(1), "manifest.json"),
+                  "w") as f:
+            f.write("{not json")
+        _, step = mgr.restore(like=_state_tree())
+        assert step == 0
+
+    def test_all_invalid_raises(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=3)
+        mgr.save(0, _state_tree())
+        rz.FaultInjector(rz.FaultPlan()).corrupt_checkpoint(
+            mgr.checkpoint_path(0))
+        with pytest.raises(rz.CheckpointError, match="no valid checkpoint"):
+            mgr.restore(like=_state_tree())
+
+    def test_empty_root_raises(self, tmp_path):
+        with pytest.raises(rz.CheckpointError):
+            rz.restore_checkpoint(str(tmp_path / "nothing"), like={})
+
+    def test_structure_mismatch_rejected(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, {"w": jnp.ones((3,))})
+        with pytest.raises(rz.CheckpointError, match="template"):
+            mgr.restore(like={"w": jnp.ones((4,))}, step=0)
+        with pytest.raises(rz.CheckpointError, match="no leaf"):
+            mgr.restore(like={"v": jnp.ones((3,))}, step=0)
+
+    def test_superset_checkpoint_rejected(self, tmp_path):
+        """A checkpoint with leaves the template dropped (structure
+        drift) must be rejected, not silently partially restored."""
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, {"w": jnp.ones((3,)), "legacy": jnp.ones((2,))})
+        with pytest.raises(rz.CheckpointError, match="template does not"):
+            mgr.restore(like={"w": jnp.ones((3,))}, step=0)
+
+    def test_pinned_step_restore(self, tmp_path):
+        mgr = rz.CheckpointManager(str(tmp_path), keep=5)
+        for s in range(3):
+            mgr.save(s, {"x": jnp.float32(s)})
+        restored, step = mgr.restore(like={"x": jnp.float32(0)}, step=1)
+        assert step == 1 and float(restored["x"]) == 1.0
+
+    def test_rotation_never_deletes_just_written_step(self, tmp_path):
+        """An undetected-corrupt newer dir occupying the keep window must
+        not cause rotation to delete the checkpoint just written — the
+        recoverable set can only grow on save (code-review finding)."""
+        tree = _state_tree()
+        mgr3 = rz.CheckpointManager(str(tmp_path), keep=3)
+        for s in (40, 41, 42):
+            mgr3.save(s, tree)
+        rz.FaultInjector(rz.FaultPlan(seed=2)).corrupt_checkpoint(
+            mgr3.checkpoint_path(42))  # CRC-corrupt, size intact
+        mgr = rz.CheckpointManager(str(tmp_path), keep=1)
+        _, resumed = mgr.restore(like=_state_tree())  # falls back to 41
+        assert resumed == 41
+        mgr.save(41, tree)  # resumed run re-saves its current step under keep=1
+        assert 41 in mgr.all_steps()
+        assert mgr.latest_valid_step() == 41  # never left unrecoverable
+
+    def test_rotation_drops_structurally_broken_dirs_first(self, tmp_path):
+        """Truncated checkpoints must not count toward ``keep``."""
+        mgr = rz.CheckpointManager(str(tmp_path), keep=2)
+        tree = _state_tree()
+        for s in range(3):
+            mgr.save(s, tree)
+        rz.FaultInjector(rz.FaultPlan()).truncate_checkpoint(
+            mgr.checkpoint_path(2), drop_bytes=5)
+        mgr.save(3, tree)  # rotation: broken 2 dropped, valid 1+3 kept
+        assert 2 not in mgr.all_steps()
+        assert {1, 3} <= set(mgr.all_steps())
+
+    def test_restore_preserves_template_sharding(self, tmp_path, mesh8):
+        """Restoring a sharded state must land the leaves on the
+        template's sharding, not collapse them to the default device."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sharding = NamedSharding(mesh8, P("dp"))
+        leaf = jax.device_put(jnp.arange(16, dtype=jnp.float32), sharding)
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, {"w": leaf})
+        restored, _ = mgr.restore(like={"w": leaf})
+        assert restored["w"].sharding == sharding
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.arange(16, dtype=np.float32))
+
+    def test_orphaned_tmp_dirs_swept_on_next_save(self, tmp_path):
+        """A hard kill mid-save leaves a tmp_* dir; the next save must
+        sweep it so repeated preemptions cannot fill the disk."""
+        orphan = tmp_path / "tmp_dead_writer"
+        orphan.mkdir(parents=True)
+        (orphan / "data.bin").write_bytes(b"\0" * 64)
+        rz.save_checkpoint(str(tmp_path), 0, _state_tree())
+        assert not orphan.exists()
+        assert rz.latest_valid_step(str(tmp_path)) == 0
+
+    def test_manifest_is_auditable_without_jax(self, tmp_path):
+        """The format contract: plain JSON manifest + raw bytes, no pickle."""
+        path = rz.save_checkpoint(str(tmp_path), 0, {"w": jnp.ones((2, 2))})
+        with open(os.path.join(path, "manifest.json")) as f:
+            manifest = json.load(f)
+        [rec] = manifest["leaves"]
+        assert rec["shape"] == [2, 2] and rec["dtype"] == "float32"
+        raw = open(os.path.join(path, "data.bin"), "rb").read()
+        np.testing.assert_array_equal(
+            np.frombuffer(raw, np.float32).reshape(2, 2), np.ones((2, 2)))
+
+
+# --------------------------------------------------------------------------
+# fault injection
+# --------------------------------------------------------------------------
+
+class TestFaultInjection:
+    def test_grad_injection_is_step_targeted(self):
+        inj = rz.FaultInjector(rz.FaultPlan(seed=0, nan_grad_steps=(3,),
+                                            inf_grad_steps=(5,)))
+        grads = {"a": jnp.ones((8,)), "b": jnp.ones((2, 2))}
+
+        def nonfinite(t):
+            return bool(jnp.any(jnp.asarray(
+                [jnp.any(~jnp.isfinite(l)) for l in jax.tree.leaves(t)])))
+
+        assert not nonfinite(inj.inject_grads(grads, jnp.int32(2)))
+        assert nonfinite(inj.inject_grads(grads, jnp.int32(3)))
+        assert nonfinite(inj.inject_grads(grads, jnp.int32(5)))
+        clean = inj.inject_grads(grads, jnp.int32(0))
+        _tree_equal(grads, clean)  # off-step injection is value-identical
+
+    def test_grad_injection_deterministic_and_jittable(self):
+        plan = rz.FaultPlan(seed=42, nan_grad_steps=(1,))
+        grads = {"a": jnp.ones((16,)), "b": jnp.ones((4, 4))}
+        out1 = rz.FaultInjector(plan).inject_grads(grads, jnp.int32(1))
+        out2 = jax.jit(rz.FaultInjector(plan).inject_grads)(
+            grads, jnp.int32(1))
+        _tree_equal(out1, out2)  # same seed -> same fault placement
+
+    def test_faults_only_target_float_leaves_without_dtype_roundtrip(self):
+        """Integer leaves (step counters riding in a grads tree) must
+        never host a NaN, and off-step execution must be value- AND
+        dtype-identical for every leaf (no fp32 roundtrip)."""
+        inj = rz.FaultInjector(rz.FaultPlan(seed=11, nan_grad_steps=(2,)))
+        grads = {"i": jnp.arange(4, dtype=jnp.int32),
+                 "h": jnp.full((8,), 1.5, jnp.bfloat16),
+                 "f": jnp.ones((4,), jnp.float32)}
+        hit = inj.inject_grads(grads, jnp.int32(2))
+        np.testing.assert_array_equal(np.asarray(hit["i"]),
+                                      np.asarray(grads["i"]))
+        n_bad = sum(int(jnp.sum(~jnp.isfinite(l)))
+                    for l in (hit["h"].astype(jnp.float32),
+                              hit["f"]))
+        assert n_bad == 1
+        miss = inj.inject_grads(grads, jnp.int32(3))
+        for k in grads:
+            assert miss[k].dtype == grads[k].dtype
+            _tree_equal(grads[k], miss[k])
+
+    def test_zero_size_leaves_cannot_host_faults(self):
+        """Grads with empty leaves (unused/optional params) must not crash
+        the injector; the fault lands on a non-empty leaf instead."""
+        inj = rz.FaultInjector(rz.FaultPlan(seed=3, nan_grad_steps=(5,)))
+        grads = {"empty": jnp.zeros((0,)), "used": jnp.ones((4,))}
+        out = inj.inject_grads(grads, jnp.int32(5))
+        assert bool(jnp.any(~jnp.isfinite(out["used"])))
+        # all-empty tree: injection is a structured no-op
+        only_empty = {"e": jnp.zeros((0,))}
+        _tree_equal(only_empty, inj.inject_grads(only_empty, jnp.int32(5)))
+
+    def test_preemption_only_at_configured_step(self):
+        inj = rz.FaultInjector(rz.FaultPlan(preempt_steps=(4,)))
+        inj.check_preemption(3)
+        with pytest.raises(rz.SimulatedPreemption) as ei:
+            inj.check_preemption(4)
+        assert ei.value.step == 4
+
+    def test_corruption_offsets_deterministic(self, tmp_path):
+        tree = {"w": jnp.arange(64, dtype=jnp.float32)}
+        p1 = rz.save_checkpoint(str(tmp_path / "a"), 0, tree)
+        p2 = rz.save_checkpoint(str(tmp_path / "b"), 0, tree)
+        offs1 = rz.FaultInjector(rz.FaultPlan(seed=9)).corrupt_checkpoint(p1)
+        offs2 = rz.FaultInjector(rz.FaultPlan(seed=9)).corrupt_checkpoint(p2)
+        assert offs1 == offs2
+
+
+# --------------------------------------------------------------------------
+# anomaly-aware guarded stepping
+# --------------------------------------------------------------------------
+
+def _quadratic_problem():
+    params = {"w": jnp.ones((4, 4), jnp.float32) * 0.5,
+              "b": jnp.zeros((4,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        pred = batch @ p["w"] + p["b"]
+        return jnp.mean(pred ** 2)
+
+    return params, loss_fn
+
+
+class TestGuardedStep:
+    def test_clean_step_applies_update(self):
+        params, loss_fn = _quadratic_problem()
+        opt, scaler = FusedAdam(lr=1e-2), LossScaler(init_scale=2.0**8)
+        step = jax.jit(rz.make_guarded_step(loss_fn, opt, scaler))
+        ostate, sstate = opt.init(params), scaler.init()
+        gstate = rz.init_guard_state(scaler)
+        batch = jnp.ones((2, 4))
+        p2, _, s2, g2, m = step(params, ostate, sstate, gstate, batch)
+        assert not bool(m["found_inf"])
+        assert int(g2.consecutive_skips) == 0
+        assert int(s2.unskipped) == 1
+        assert not np.array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+        assert rz.nonfinite_report(m["nonfinite"]) == {}
+
+    def test_overflow_skips_bit_identically(self):
+        params, loss_fn = _quadratic_problem()
+        opt, scaler = FusedAdam(lr=1e-2), LossScaler(init_scale=2.0**8)
+        step = jax.jit(rz.make_guarded_step(loss_fn, opt, scaler))
+        ostate, sstate = opt.init(params), scaler.init()
+        gstate = rz.init_guard_state(scaler)
+        bad = jnp.full((2, 4), jnp.inf)
+        p2, o2, s2, g2, m = step(params, ostate, sstate, gstate, bad)
+        assert bool(m["found_inf"])
+        _tree_equal(params, p2)      # the capturable skip: params untouched
+        _tree_equal(ostate, o2)      # ... and moments/step untouched
+        assert int(s2.unskipped) == 0
+        assert int(g2.consecutive_skips) == 1
+        assert int(g2.total_skips) == 1
+
+    def test_localization_names_offending_leaf(self):
+        grads = {"clean": jnp.ones((4,)),
+                 "dirty": jnp.asarray([1.0, jnp.nan, jnp.inf, 2.0])}
+        report = rz.nonfinite_report(rz.nonfinite_counts(grads))
+        assert list(report) == ["['dirty']"]
+        assert report["['dirty']"] == 2
+
+    def test_patience_trip_halves_floor_below_min_scale(self):
+        """After ``patience`` consecutive skips the dynamic floor drops
+        below the configured min_loss_scale — the degradation path that
+        replaces an infinite skip loop."""
+        scaler = LossScaler(init_scale=4.0, min_loss_scale=1.0)
+        cfg = rz.GuardConfig(patience=2, min_floor=2.0**-4)
+        sstate, gstate = scaler.init(), rz.init_guard_state(scaler)
+        bad = jnp.ones((), jnp.bool_)
+        floors, scales = [], []
+        for _ in range(8):
+            sstate, gstate = rz.guarded_update(
+                scaler, sstate, gstate, bad, cfg)
+            floors.append(float(gstate.scale_floor))
+            scales.append(float(sstate.scale))
+        assert floors[0] == 1.0          # first skip: floor untouched
+        assert floors[1] == 0.5          # patience hit: floor halves
+        assert min(floors) == 2.0**-4    # ... and clamps at min_floor
+        assert min(scales) <= 2.0**-4    # scale actually followed it down
+        assert min(scales) > 0.0
+
+    def test_trip_step_backs_off_exactly_once(self):
+        """With default hysteresis=1 the scaler already backs off on each
+        overflow; the patience trip must not compound it into
+        backoff_factor**2 per step (code-review finding)."""
+        scaler = LossScaler(init_scale=2.0**16, min_loss_scale=1.0)
+        cfg = rz.GuardConfig(patience=2, min_floor=2.0**-10)
+        sstate, gstate = scaler.init(), rz.init_guard_state(scaler)
+        bad = jnp.ones((), jnp.bool_)
+        prev = float(sstate.scale)
+        for _ in range(6):
+            sstate, gstate = rz.guarded_update(
+                scaler, sstate, gstate, bad, cfg)
+            cur = float(sstate.scale)
+            assert cur == prev * 0.5, (
+                f"scale moved {prev} -> {cur}, expected exactly one halving")
+            prev = cur
+
+    def test_guard_config_rejects_degenerate_patience(self):
+        """patience=0 would trip on clean steps and destroy loss scaling."""
+        with pytest.raises(ValueError, match="patience"):
+            rz.GuardConfig(patience=0)
+        with pytest.raises(ValueError, match="floor_backoff"):
+            rz.GuardConfig(floor_backoff=0.0)
+        with pytest.raises(ValueError, match="min_floor"):
+            rz.GuardConfig(min_floor=0.0)
+
+    def test_static_scaler_scale_never_moves_under_guard(self):
+        """dynamic=False means the scale is a constant; the guard's
+        forced backoff must respect that (only counters/events remain)."""
+        from apex_tpu.amp.scaler import static_loss_scaler
+
+        scaler = static_loss_scaler(128.0)
+        cfg = rz.GuardConfig(patience=2)
+        sstate, gstate = scaler.init(), rz.init_guard_state(scaler)
+        bad = jnp.ones((), jnp.bool_)
+        for _ in range(6):
+            sstate, gstate = rz.guarded_update(
+                scaler, sstate, gstate, bad, cfg)
+        assert float(sstate.scale) == 128.0
+        assert int(gstate.total_skips) == 6  # accounting still works
+
+    def test_clean_step_resets_consecutive_counter(self):
+        scaler = LossScaler(init_scale=2.0**8)
+        cfg = rz.GuardConfig(patience=3)
+        sstate, gstate = scaler.init(), rz.init_guard_state(scaler)
+        bad, ok = jnp.ones((), jnp.bool_), jnp.zeros((), jnp.bool_)
+        sstate, gstate = rz.guarded_update(scaler, sstate, gstate, bad, cfg)
+        sstate, gstate = rz.guarded_update(scaler, sstate, gstate, bad, cfg)
+        assert int(gstate.consecutive_skips) == 2
+        sstate, gstate = rz.guarded_update(scaler, sstate, gstate, ok, cfg)
+        assert int(gstate.consecutive_skips) == 0
+        assert int(gstate.total_skips) == 2
+        assert float(gstate.scale_floor) == scaler.min_loss_scale
+
+    def test_floor_event_emitted(self):
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        logger = logging.getLogger("apex_tpu.events")
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        try:
+            scaler = LossScaler(init_scale=4.0)
+            cfg = rz.GuardConfig(patience=1)
+            sstate, gstate = scaler.init(), rz.init_guard_state(scaler)
+            sstate, gstate = rz.guarded_update(
+                scaler, sstate, gstate, jnp.ones((), jnp.bool_), cfg)
+            jax.effects_barrier()
+        finally:
+            logger.removeHandler(handler)
+        events = [json.loads(r) for r in records]
+        assert any(e["event"] == "loss_scale_floor_halved" for e in events)
+        [ev] = [e for e in events if e["event"] == "loss_scale_floor_halved"]
+        assert ev["consecutive_skips"] == 1
+
+    def test_guard_state_checkpoints(self, tmp_path):
+        scaler = LossScaler()
+        gstate = rz.init_guard_state(scaler)._replace(
+            total_skips=jnp.int32(7), scale_floor=jnp.float32(0.25))
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, gstate)
+        restored, _ = mgr.restore(like=rz.init_guard_state(scaler))
+        _tree_equal(gstate, restored)
+
+
+# --------------------------------------------------------------------------
+# structured events
+# --------------------------------------------------------------------------
+
+def test_emit_event_is_json_parseable():
+    ev = emit_event("unit_test_event", answer=42, label="x")
+    assert ev["event"] == "unit_test_event" and ev["answer"] == 42
+    # and the logged line itself is a single JSON document
+    line = json.dumps(ev, sort_keys=True, default=str)
+    assert json.loads(line)["label"] == "x"
+
+
+# --------------------------------------------------------------------------
+# cross-microbatch skip consistency (pipeline layer)
+# --------------------------------------------------------------------------
+
+class TestMicrobatchSkipConsistency:
+    def test_one_bad_microbatch_poisons_the_step(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            forward_backward_no_pipelining,
+        )
+
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        scaler = LossScaler(init_scale=2.0**4)
+        sstate = scaler.init()
+
+        def loss_fn(p, mb):
+            return jnp.sum(p["w"] * mb)
+
+        clean = jnp.ones((3, 4))
+        loss, grads, found_inf = forward_backward_no_pipelining(
+            loss_fn, params, clean, grad_scaler=scaler, scaler_state=sstate,
+            with_found_inf=True)
+        assert not bool(found_inf)
+
+        dirty = clean.at[1, 2].set(jnp.inf)  # ONE bad microbatch of three
+        loss, grads, found_inf = forward_backward_no_pipelining(
+            loss_fn, params, dirty, grad_scaler=scaler, scaler_state=sstate,
+            with_found_inf=True)
+        assert bool(found_inf)
+        # all-or-nothing: the whole accumulated update is skipped
+        opt = FusedAdam(lr=1e-2)
+        ostate = opt.init(params)
+        unscaled, _ = scaler.unscale(grads, sstate)
+        p2, o2 = opt.step(unscaled, params, ostate, found_inf=found_inf)
+        _tree_equal(params, p2)
+        _tree_equal(ostate, o2)
+
+    def test_accumulated_flag_matches_per_microbatch_or(self):
+        """Detection on summed grads == OR over per-microbatch checks
+        (nonfinite is absorbing under IEEE addition) — the invariant the
+        schedules rely on for consistent skip semantics."""
+        from apex_tpu.transformer.pipeline_parallel import (
+            accumulated_found_inf,
+        )
+
+        per_mb = [
+            {"w": jnp.ones((4,))},
+            {"w": jnp.asarray([1.0, jnp.inf, -jnp.inf, 0.0])},
+            {"w": jnp.asarray([1.0, -jnp.inf, jnp.inf, 0.0])},  # cancels to nan
+        ]
+        summed = jax.tree.map(lambda *ls: sum(ls), *per_mb)
+        assert bool(accumulated_found_inf(summed))
+        assert not bool(accumulated_found_inf(
+            jax.tree.map(lambda *ls: sum(ls), per_mb[0], per_mb[0])))
+
+
+# --------------------------------------------------------------------------
+# state round-trips: every NamedTuple/dataclass state in amp/ + optimizers/
+# --------------------------------------------------------------------------
+
+_OPTIMIZERS = [
+    pytest.param(lambda: FusedAdam(lr=1e-2), id="FusedAdam"),
+    pytest.param(lambda: FusedAdam(lr=1e-2, master_weights=True),
+                 id="FusedAdam-masters"),
+    pytest.param(lambda: FusedAdam(lr=1e-2, state_dtype=jnp.bfloat16),
+                 id="FusedAdam-bf16-moments"),
+    pytest.param(lambda: FusedLAMB(lr=1e-2), id="FusedLAMB"),
+    pytest.param(lambda: FusedSGD(lr=1e-2, momentum=0.9), id="FusedSGD"),
+    pytest.param(lambda: FusedNovoGrad(lr=1e-2), id="FusedNovoGrad"),
+    pytest.param(lambda: FusedAdagrad(lr=1e-2), id="FusedAdagrad"),
+    pytest.param(lambda: FusedMixedPrecisionLamb(lr=1e-2),
+                 id="FusedMixedPrecisionLamb"),
+]
+
+
+class TestStateRoundTrip:
+    @pytest.mark.parametrize("make_opt", _OPTIMIZERS)
+    def test_optimizer_state_dict_roundtrip(self, make_opt):
+        """init -> one real step (non-trivial moments) -> save -> restore
+        into a fresh init: bit-identical, for every optimizer state type."""
+        opt = make_opt()
+        params = {"w": jnp.ones((4, 2), jnp.bfloat16),
+                  "b": jnp.ones((2,), jnp.float32)}
+        state = opt.init(params)
+        grads = {"w": jnp.full((4, 2), 0.25, jnp.float32),
+                 "b": jnp.full((2,), -0.5, jnp.float32)}
+        _, state = opt.step(grads, params, state)
+        d = opt.state_dict(state)
+        assert all(isinstance(v, np.ndarray) for v in d.values())
+        restored = opt.load_state_dict(d, like=opt.init(params))
+        _tree_equal(state, restored)
+
+    @pytest.mark.parametrize("make_opt", _OPTIMIZERS)
+    def test_optimizer_state_checkpoint_roundtrip(self, make_opt, tmp_path):
+        opt = make_opt()
+        params = {"w": jnp.ones((3, 3), jnp.float32)}
+        state = opt.init(params)
+        _, state = opt.step({"w": jnp.full((3, 3), 0.1)}, params, state)
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, state)
+        restored, _ = mgr.restore(like=opt.init(params))
+        _tree_equal(state, restored)
+
+    def test_scaler_state_roundtrip_including_unskipped(self, tmp_path):
+        scaler = LossScaler(hysteresis=2)
+        st = scaler.init()
+        for flag in (False, False, True, False):
+            st = scaler.update(st, jnp.bool_(flag))
+        assert int(st.unskipped) == 3  # the checkpoint-parity counter moved
+        # via state_dict (amp parity path)
+        st2 = scaler.load_state_dict(scaler.state_dict(st))
+        _tree_equal(st, st2)
+        # via the validated checkpoint path
+        mgr = rz.CheckpointManager(str(tmp_path))
+        mgr.save(0, st)
+        restored, _ = mgr.restore(like=scaler.init())
+        _tree_equal(st, restored)
+
+    def test_amp_state_dict_roundtrip(self):
+        """amp.state_dict / amp.load_state_dict across every per-loss
+        scaler state (AmpState dataclass plumbing)."""
+        amped = amp.initialize(lambda p, x: x, {}, opt_level="O2",
+                               num_losses=2)
+        states = [amped.scaler.update(s, jnp.bool_(i == 0))
+                  for i, s in enumerate(amped.scaler_states)]
+        amped.scaler_states = states
+        d = amp.state_dict(amped)
+        amped2 = amp.initialize(lambda p, x: x, {}, opt_level="O2",
+                                num_losses=2)
+        amped2 = amp.load_state_dict(amped2, d)
+        _tree_equal(states, amped2.scaler_states)
+
+
+# --------------------------------------------------------------------------
+# acceptance: kill mid-run, corrupt newest checkpoint, restart, resume
+# --------------------------------------------------------------------------
+
+N_STEPS = 12
+PREEMPT_AT = 7
+
+
+def _build():
+    params = {"w": jnp.full((6, 6), 0.3, jnp.float32),
+              "b": jnp.zeros((6,), jnp.float32)}
+    opt = FusedAdam(lr=5e-2)
+    scaler = LossScaler(init_scale=2.0**6, growth_interval=4)
+
+    def loss_fn(p, batch):
+        pred = jnp.tanh(batch @ p["w"]) + p["b"]
+        return jnp.mean((pred - 1.0) ** 2)
+
+    return params, opt, scaler, loss_fn
+
+
+def _batch(rng_key, i):
+    return jax.random.normal(jax.random.fold_in(rng_key, i), (4, 6))
+
+
+def _train(ckpt_root, *, injector=None, keep=3):
+    """Restart-safe training loop (the docs/index.md recipe shape).
+
+    Returns (state, {step: loss}) for the steps THIS invocation ran.
+    """
+    params, opt, scaler, loss_fn = _build()
+    step_fn = jax.jit(rz.make_guarded_step(loss_fn, opt, scaler))
+    state = {"params": params, "opt": opt.init(params),
+             "scaler": scaler.init(), "guard": rz.init_guard_state(scaler),
+             "rng": jax.random.PRNGKey(0)}
+    mgr = rz.CheckpointManager(str(ckpt_root), keep=keep)
+    try:
+        state, last = mgr.restore(like=state)
+        start = last + 1
+    except rz.CheckpointError:
+        start = 0
+    losses = {}
+    for i in range(start, N_STEPS):
+        if injector is not None:
+            injector.check_preemption(i)
+        out = step_fn(state["params"], state["opt"], state["scaler"],
+                      state["guard"], _batch(state["rng"], i))
+        state = dict(zip(("params", "opt", "scaler", "guard"), out[:4]),
+                     rng=state["rng"])
+        losses[i] = float(out[4]["loss"])
+        mgr.save(i, state)
+    return state, losses
+
+
+def test_preempt_corrupt_restart_resumes_bit_identically(tmp_path):
+    """THE acceptance run (ISSUE 1): a training loop is killed mid-run by
+    an injected preemption, the newest on-disk checkpoint is corrupted,
+    the run restarts, falls back to the last VALID checkpoint, and
+    resumes with bit-identical params/optimizer/scaler state and a loss
+    trajectory matching an uninterrupted run exactly."""
+    # reference: uninterrupted
+    ref_root = tmp_path / "ref"
+    ref_state, ref_losses = _train(ref_root, keep=N_STEPS)
+    assert sorted(ref_losses) == list(range(N_STEPS))
+
+    # victim: killed at step PREEMPT_AT, newest checkpoint then corrupted
+    victim_root = tmp_path / "victim"
+    injector = rz.FaultInjector(rz.FaultPlan(seed=13,
+                                             preempt_steps=(PREEMPT_AT,)))
+    with pytest.raises(rz.SimulatedPreemption):
+        _train(victim_root, injector=injector)
+    mgr = rz.CheckpointManager(str(victim_root), keep=3)
+    newest = mgr.all_steps()[-1]
+    assert newest == PREEMPT_AT - 1
+    injector.corrupt_checkpoint(mgr.checkpoint_path(newest))
+
+    # restart: must fall back past the corrupt newest...
+    assert mgr.latest_valid_step() == newest - 1
+
+    # ...restore bit-identical state at that step (vs. the reference's
+    # checkpoint of the same step)...
+    params, opt, scaler, _ = _build()
+    like = {"params": params, "opt": opt.init(params),
+            "scaler": scaler.init(), "guard": rz.init_guard_state(scaler),
+            "rng": jax.random.PRNGKey(0)}
+    resumed_state, resumed_step = rz.restore_checkpoint(
+        str(victim_root), like)
+    assert resumed_step == newest - 1
+    ref_at_step, _ = rz.restore_checkpoint(
+        str(ref_root), like, step=resumed_step)
+    _tree_equal(resumed_state, ref_at_step)
+
+    # ...and finish the run on the reference trajectory, bit for bit.
+    final_state, resumed_losses = _train(victim_root)
+    assert sorted(resumed_losses) == list(range(resumed_step + 1, N_STEPS))
+    for i, loss in resumed_losses.items():
+        assert loss == ref_losses[i], (
+            f"post-resume loss diverged at step {i}: {loss} != {ref_losses[i]}")
+    _tree_equal(final_state["params"], ref_state["params"])
+    _tree_equal(final_state["opt"], ref_state["opt"])
+    _tree_equal(final_state["scaler"], ref_state["scaler"])
+    _tree_equal(final_state["guard"], ref_state["guard"])
+
+
+def test_injected_nan_step_skips_but_run_recovers(tmp_path):
+    """A transient NaN-gradient fault must cost one skipped step (scale
+    backs off) and leave the run converging — not poison the params."""
+    params, opt, scaler, loss_fn = _build()
+    injector = rz.FaultInjector(rz.FaultPlan(seed=3, nan_grad_steps=(2,)))
+    scaler_state, gstate = scaler.init(), rz.init_guard_state(scaler)
+    ostate = opt.init(params)
+    rng = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def step_fn(p, o, s, g, batch, i):
+        def scaled(pp):
+            loss = loss_fn(pp, batch)
+            return scaler.scale_loss(loss, s), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(p)
+        grads = injector.inject_grads(grads, i)  # fault inside jit
+        grads, found_inf = scaler.unscale(grads, s)
+        p2, o2 = opt.step(grads, p, o, found_inf=found_inf)
+        s2, g2 = rz.guarded_update(scaler, s, g, found_inf)
+        return p2, o2, s2, g2, loss, found_inf
+
+    eval_batch = _batch(rng, 1000)  # held-out: same batch before and after
+    loss_before = float(loss_fn(params, eval_batch))
+    skipped, losses = [], []
+    for i in range(6):
+        params, ostate, scaler_state, gstate, loss, found_inf = step_fn(
+            params, ostate, scaler_state, gstate, _batch(rng, i),
+            jnp.int32(i))
+        skipped.append(bool(found_inf))
+        losses.append(float(loss))
+    assert skipped == [False, False, True, False, False, False]
+    assert int(gstate.total_skips) == 1
+    assert all(np.isfinite(l) for l in losses)
+    assert all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree.leaves(params))
+    # still converging after the fault (same held-out batch, fewer nats)
+    assert float(loss_fn(params, eval_batch)) < loss_before

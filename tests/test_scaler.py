@@ -125,6 +125,55 @@ def test_hysteresis_resets_on_clean_steps():
     assert float(st.scale) == 2.0**15
 
 
+def test_growth_clamped_at_default_max():
+    """Growth must clamp at the reference default max_loss_scale=2**24
+    (apex/amp/scaler.py) — from one doubling below, and then stay put."""
+    s = LossScaler(init_scale=2.0**23, growth_interval=1)
+    st = s.init()
+    ok = jnp.zeros((), jnp.bool_)
+    st = s.update(st, ok)
+    assert float(st.scale) == 2.0**24
+    for _ in range(3):
+        st = s.update(st, ok)
+        assert float(st.scale) == 2.0**24  # clamped, not growing past max
+        assert int(st.growth_tracker) == 0
+
+
+def test_backoff_clamped_at_min_loss_scale():
+    """Backoff must clamp at min_loss_scale: from 1.5x the floor one
+    overflow lands ON the floor (max(0.75*min... ) rule), and further
+    overflows cannot push below it."""
+    s = LossScaler(init_scale=3.0, min_loss_scale=2.0)
+    st = s.init()
+    bad = jnp.ones((), jnp.bool_)
+    st = s.update(st, bad)
+    assert float(st.scale) == 2.0  # 1.5 would be below the floor
+    for _ in range(3):
+        st = s.update(st, bad)
+        assert float(st.scale) == 2.0
+
+
+def test_hysteresis_tolerates_exactly_h_minus_1_overflows():
+    """hysteresis=h must tolerate exactly h-1 *consecutive* overflows
+    before backing off — the h-th burns the budget
+    (csrc/update_scale_hysteresis.cu decrement-then-test order)."""
+    h = 3
+    s = LossScaler(init_scale=2.0**12, hysteresis=h)
+    st = s.init()
+    bad = jnp.ones((), jnp.bool_)
+    for i in range(h - 1):
+        st = s.update(st, bad)
+        assert float(st.scale) == 2.0**12, f"backed off after {i+1} < h overflows"
+        assert int(st.hysteresis_tracker) == h - 1 - i
+    st = s.update(st, bad)  # the h-th consecutive overflow
+    assert float(st.scale) == 2.0**11
+    # a clean step restores the full budget, so h-1 overflows pass again
+    st = s.update(st, jnp.zeros((), jnp.bool_))
+    for _ in range(h - 1):
+        st = s.update(st, bad)
+    assert float(st.scale) == 2.0**11
+
+
 def test_unscale_returns_fp32():
     """Unscaling must not happen in fp16 (subnormal flush)."""
     import jax.numpy as jnp

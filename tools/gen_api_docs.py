@@ -77,8 +77,14 @@ PAGES = {
         "apex_tpu.contrib.conv_bias_relu", "apex_tpu.contrib.sparsity",
         "apex_tpu.contrib.clip_grad", "apex_tpu.contrib.openfold_triton",
     ]),
+    "resilience": ("Training resilience", [
+        "apex_tpu.resilience", "apex_tpu.resilience.checkpoint",
+        "apex_tpu.resilience.fault_injection",
+        "apex_tpu.resilience.guarded",
+    ]),
     "utils": ("Utilities", [
         "apex_tpu.utils.nvtx", "apex_tpu.utils.packing",
+        "apex_tpu.utils.serialization",
         "apex_tpu.feature_registry", "apex_tpu._logging",
     ]),
 }
@@ -163,9 +169,74 @@ def _render_symbol(name: str, obj) -> list[str]:
     return lines
 
 
+# static per-page preamble rendered between the title and the module
+# listings (deterministic text; the introspected API follows it)
+PAGE_PROLOGUE = {
+    "resilience": """\
+Survive preemption, corruption, and numerical blow-ups: validated atomic
+checkpointing, deterministic fault injection, and anomaly-aware step
+skipping.  Every recovery path below is exercised by tier-1 tests
+(`tests/test_resilience.py`), including a full kill → corrupt → restart →
+bit-identical-resume cycle.
+
+## Checkpoint format
+
+One directory per step, written to a temp name and atomically
+`os.replace`-renamed into place (a kill at any byte offset leaves either
+the old checkpoint set or a complete new one):
+
+```
+<root>/step_0000000042/manifest.json   # format_version, step, per-leaf records
+<root>/step_0000000042/data.bin        # concatenated raw little-endian bytes
+```
+
+`manifest.json` records `(path, shape, dtype, offset, nbytes, crc32)` for
+every leaf — leaves are addressed by `jax.tree_util.keystr` path, so any
+mix of dicts / NamedTuples (`AdamState`, `LossScalerState`) / typed PRNG
+keys round-trips without custom serializers, and a checkpoint can be
+audited with nothing but the manifest and `np.frombuffer`.  Keep-last-K
+rotation runs only after the new checkpoint is durable.
+
+## Recovery semantics
+
+`restore_checkpoint(root, like)` walks checkpoints newest-first,
+validates each candidate (manifest parse, payload size vs. manifest —
+truncation; per-leaf CRC — bit corruption; shape/dtype vs. the `like`
+template — structure drift) and loads the newest one that proves good,
+emitting a `checkpoint_rejected` event for each one skipped.  Validation
+happens *before* any training state is touched; a corrupt latest costs
+one checkpoint interval, never the run.  `CheckpointError` is raised only
+when nothing valid remains.
+
+## Fault injection
+
+`FaultInjector(FaultPlan(seed, nan_grad_steps, inf_grad_steps,
+preempt_steps))` drives all three production fault classes
+deterministically: jit-safe NaN/Inf gradient injection at chosen steps
+(`inject_grads`), a simulated SIGTERM at the host step boundary
+(`check_preemption` raising `SimulatedPreemption`), and on-disk
+checkpoint damage (`corrupt_checkpoint` / `truncate_checkpoint`).  The
+same seed produces the same faults on every run — recovery paths are
+tested, not discovered.
+
+## Anomaly-aware stepping
+
+`make_guarded_step(loss_fn, optimizer, scaler)` builds a jit-safe train
+step that localizes non-finite gradients per leaf (`nonfinite_counts` /
+`nonfinite_report`), applies the capturable skip, and tracks consecutive
+skips in `GuardState`; after `GuardConfig.patience` consecutive skips it
+halves the dynamic loss-scale floor (continuing below the configured
+`min_loss_scale`) and emits a structured `loss_scale_floor_halved` event
+instead of silently looping.
+""",
+}
+
+
 def render_page(key: str) -> str:
     title, modules = PAGES[key]
     out = [f"# {title}\n"]
+    if key in PAGE_PROLOGUE:
+        out.append(PAGE_PROLOGUE[key])
     for modname in modules:
         try:
             mod = importlib.import_module(modname)
@@ -267,6 +338,35 @@ spec = PipelineStageSpec(stage_fn=block_fn, first_fn=embed_fn,
                          last_fn=loss_fn)
 loss, grads = forward_backward_pipelining_1f1b(spec, stage_params, batches)
 ```
+
+Resilient training — validated checkpoints every K steps, automatic
+fallback past a corrupt latest, anomaly-aware skipping
+([full page](api/resilience.md)):
+
+```python
+from apex_tpu import resilience as rz
+
+mgr = rz.CheckpointManager("/ckpts/run7", keep=3)
+gstate = rz.init_guard_state(scaler)
+step = jax.jit(rz.make_guarded_step(loss_fn, opt, scaler))
+
+state = {"params": params, "opt": opt_state,
+         "scaler": sstate, "guard": gstate, "rng": rng}
+try:                                     # restart-safe entry
+    state, last = mgr.restore(like=state)   # newest VALID checkpoint
+    start = last + 1
+except rz.CheckpointError:
+    start = 0
+for i in range(start, num_steps):
+    out = step(state["params"], state["opt"], state["scaler"],
+               state["guard"], next_batch(state["rng"], i))
+    state.update(zip(("params", "opt", "scaler", "guard"), out[:4]))
+    mgr.save(i, state)                   # atomic write + keep-last-K
+```
+
+A checkpoint root assumes a **single writer**: in multi-controller runs
+gate `mgr.save` on `jax.process_index() == 0` (or give each process its
+own root) — concurrent saves into one root race the temp-dir sweep.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

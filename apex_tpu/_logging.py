@@ -26,20 +26,39 @@ class RankInfoFilter(logging.Filter):
         return True
 
 
+_RANK_INFO_WARNED: set = set()
+
+
+def _debug_once(key: str, what: str, exc: Exception) -> None:
+    """Log a swallowed rank-info failure ONCE at debug level.
+
+    The flag is set *before* logging: the debug record flows through the
+    rank-aware handler, whose filter re-enters :func:`_rank_info` — the
+    guard is what keeps that recursion one level deep.
+    """
+    if key in _RANK_INFO_WARNED:
+        return
+    _RANK_INFO_WARNED.add(key)
+    logging.getLogger("apex_tpu._logging").debug(
+        "%s unavailable (further failures silent): %s: %s",
+        what, type(exc).__name__, exc)
+
+
 def _rank_info() -> str:
     try:
         import jax
 
         parts = [f"p{jax.process_index()}"]
-    except Exception:
+    except Exception as e:
+        _debug_once("process_index", "jax process index", e)
         return "p?"
     try:
         from apex_tpu.transformer import parallel_state
 
         if parallel_state.model_parallel_is_initialized():
             parts.append(parallel_state.get_rank_info())
-    except Exception:
-        pass
+    except Exception as e:
+        _debug_once("parallel_state", "mesh rank info", e)
     return "|".join(parts)
 
 
@@ -71,7 +90,7 @@ def get_logger(name: str) -> logging.Logger:
     return logging.getLogger(f"apex_tpu.{name}")
 
 
-def emit_event(kind: str, **fields) -> dict:
+def emit_event(kind: str, *, t0: float | None = None, **fields) -> dict:
     """Emit a structured (JSON) operational event and return it.
 
     The resilience subsystem reports state transitions — checkpoint
@@ -81,8 +100,16 @@ def emit_event(kind: str, **fields) -> dict:
     loops are banned; see :mod:`apex_tpu.resilience`).  Events ride the
     ordinary ``apex_tpu.events`` logger and therefore inherit the
     rank-aware handler installed at import.
+
+    Timing events pass ``t0`` — a ``time.monotonic()`` stamp taken when
+    the operation started — and get a ``duration_s`` field computed on
+    the monotonic clock.  ``time.time()`` (the ``time`` field) is for
+    cross-host correlation only: the wall clock steps under NTP and is
+    exactly what a stall watchdog must NOT measure with.
     """
     event = {"event": kind, "time": time.time(), **fields}
+    if t0 is not None:
+        event["duration_s"] = round(time.monotonic() - t0, 6)
     logging.getLogger("apex_tpu.events").info(
         "%s", json.dumps(event, sort_keys=True, default=str))
     return event

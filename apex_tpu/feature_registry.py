@@ -67,3 +67,5 @@ register("sparsity", "2:4 structured sparsity (ASP)", False)
 register("halo_exchange", "spatial-parallel halo exchange", False, "ppermute")
 register("resilience", "validated checkpointing + fault injection + guarded stepping",
          False, "host I/O + jnp")
+register("supervisor", "step watchdog + heartbeat + transient retry + data guard + escalation",
+         False, "host threads + I/O")

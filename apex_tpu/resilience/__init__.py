@@ -20,6 +20,18 @@ kill, corrupt, restart, converge — a tested code path:
   consecutive-skip counter, and bounded degradation (halve the dynamic
   loss-scale floor after ``patience`` skips, with a structured event)
   instead of a silent infinite skip loop.
+- :mod:`.supervisor` — the host-loop layer over all of it: a step
+  watchdog (per-step deadline on a monotonic clock, monitor thread that
+  dumps diagnostics mid-stall, heartbeat file for external
+  orchestrators) and the :class:`TrainingSupervisor` escalation policy
+  — consecutive unrecovered failures trigger emergency-checkpoint-then-
+  clean-abort (graceful degradation, resumable by construction).
+- :mod:`.retry` — classified-exception retry with exponential backoff
+  and deterministic jitter for host I/O (checkpoint save/restore, data
+  fetch), one structured event per attempt.
+- :mod:`.data_guard` — validating iterator wrapper (tree/shape/dtype/
+  finiteness against a batch spec) with a bounded corrupt-batch skip
+  budget and a producer stall timeout.
 
 End-to-end recipe (the shape tier-1's preemption/corruption test runs)::
 
@@ -58,10 +70,20 @@ from apex_tpu.resilience.checkpoint import (
     save_checkpoint,
     validate_checkpoint,
 )
+from apex_tpu.resilience.data_guard import (
+    DataStallError,
+    GuardedIterator,
+    SkipBudgetExceeded,
+    spec_of,
+    validate_batch,
+)
 from apex_tpu.resilience.fault_injection import (
+    CorruptBatch,
     FaultInjector,
     FaultPlan,
+    FlakyIterator,
     SimulatedPreemption,
+    SlowStep,
 )
 from apex_tpu.resilience.guarded import (
     GuardConfig,
@@ -72,6 +94,22 @@ from apex_tpu.resilience.guarded import (
     nonfinite_counts,
     nonfinite_report,
 )
+from apex_tpu.resilience.retry import (
+    RetryExhausted,
+    RetryPolicy,
+    TransientError,
+    is_transient,
+    retry_transient,
+)
+from apex_tpu.resilience.supervisor import (
+    StepDeadlineExceeded,
+    StepWatchdog,
+    SupervisorConfig,
+    TrainingAborted,
+    TrainingSupervisor,
+    read_heartbeat,
+    write_heartbeat,
+)
 
 __all__ = [
     "CheckpointError",
@@ -80,9 +118,12 @@ __all__ = [
     "restore_checkpoint",
     "save_checkpoint",
     "validate_checkpoint",
+    "CorruptBatch",
     "FaultInjector",
     "FaultPlan",
+    "FlakyIterator",
     "SimulatedPreemption",
+    "SlowStep",
     "GuardConfig",
     "GuardState",
     "guarded_update",
@@ -90,4 +131,21 @@ __all__ = [
     "make_guarded_step",
     "nonfinite_counts",
     "nonfinite_report",
+    "DataStallError",
+    "GuardedIterator",
+    "SkipBudgetExceeded",
+    "spec_of",
+    "validate_batch",
+    "RetryExhausted",
+    "RetryPolicy",
+    "TransientError",
+    "is_transient",
+    "retry_transient",
+    "StepDeadlineExceeded",
+    "StepWatchdog",
+    "SupervisorConfig",
+    "TrainingAborted",
+    "TrainingSupervisor",
+    "read_heartbeat",
+    "write_heartbeat",
 ]

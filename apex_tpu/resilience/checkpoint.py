@@ -75,6 +75,11 @@ _DATA = "data.bin"
 class CheckpointError(RuntimeError):
     """A checkpoint failed validation, or no valid checkpoint exists."""
 
+    # deterministic by definition, and the message may embed wrapped I/O
+    # error text (the rejected-candidates list) that would match
+    # RetryPolicy.transient_markers — never retried (retry.is_transient)
+    transient = False
+
 
 def _step_dirname(step: int) -> str:
     return f"{_STEP_PREFIX}{step:010d}"
@@ -123,7 +128,7 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
     the save on ``jax.process_index() == 0`` or give each process its
     own root.
     """
-    t0 = time.perf_counter()
+    t0 = time.monotonic()
     os.makedirs(root, exist_ok=True)
     # sweep tmp dirs orphaned by a hard kill mid-save (single-writer root:
     # any tmp_* present now is dead weight that rotation would never see)
@@ -207,8 +212,7 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
                 shutil.rmtree(os.path.join(root, _step_dirname(old)),
                               ignore_errors=True)
     emit_event("checkpoint_saved", step=int(step), bytes=offset,
-               wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
-               path=final_dir)
+               path=final_dir, t0=t0)
     return final_dir
 
 
@@ -223,10 +227,16 @@ def _read_manifest(ckpt_dir: str) -> dict:
     """
     manifest_path = os.path.join(ckpt_dir, _MANIFEST)
     data_path = os.path.join(ckpt_dir, _DATA)
+    # ANY OSError here — missing, PermissionError after an orchestrator
+    # restart — rejects the candidate so the fallback walk continues to an
+    # older step: the manifest probe decides "is this a usable checkpoint",
+    # unlike _read_record's mid-payload reads where an OSError on an open
+    # file is environmental and propagates for the manager's retry.
+    # UnicodeDecodeError: json.load on bit-flipped manifest bytes.
     try:
         with open(manifest_path) as f:
             manifest = json.load(f)
-    except (OSError, json.JSONDecodeError) as e:
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
         raise CheckpointError(f"{ckpt_dir}: unreadable manifest: {e}") from e
     if not isinstance(manifest, dict) or not isinstance(
             manifest.get("leaves"), list):
@@ -267,6 +277,12 @@ def _read_record(f, rec: dict, ckpt_dir: str) -> np.ndarray:
         arr = np.frombuffer(chunk, dtype=np_dtype(rec["dtype"]))
         arr = arr.reshape(rec["shape"])
     except CheckpointError:
+        raise
+    except OSError:
+        # seek/read failure on an OPEN file is host I/O (a blipping
+        # network filesystem), not evidence about the checkpoint's bytes —
+        # propagate unwrapped so CheckpointManager's RetryPolicy engages
+        # instead of the fallback walk silently resuming an older step
         raise
     except Exception as e:  # corrupt record metadata, not a code path bug
         raise CheckpointError(
@@ -372,7 +388,7 @@ def restore_checkpoint(root: str, like: Any, *,
     errors: list[str] = []
     for s in candidates:
         ckpt_dir = os.path.join(root, _step_dirname(s))
-        t0 = time.perf_counter()
+        t0 = time.monotonic()
         try:
             # validation is fused into the load (structural checks, then
             # per-leaf CRC as each chunk is sliced) — one payload pass
@@ -384,8 +400,7 @@ def restore_checkpoint(root: str, like: Any, *,
                 raise
             continue
         emit_event("checkpoint_restored", step=int(got_step),
-                   wall_ms=round((time.perf_counter() - t0) * 1e3, 3),
-                   fallback=bool(candidates[0] != s))
+                   fallback=bool(candidates[0] != s), t0=t0)
         return tree, got_step
     raise CheckpointError(
         f"no valid checkpoint under {root!r}"
@@ -396,6 +411,15 @@ def restore_checkpoint(root: str, like: Any, *,
 class CheckpointManager:
     """Keep-last-K manager over one checkpoint root.
 
+    ``retry`` (a :class:`~apex_tpu.resilience.retry.RetryPolicy`) makes
+    save/restore survive *transient* host I/O errors — a blipping
+    network filesystem, a busy disk.  Safe to retry by construction:
+    the save path sweeps its own temp litter and commits by atomic
+    rename (re-running is idempotent), and on restore only the
+    transient class is retried — a :class:`CheckpointError` is
+    deterministic (corrupt bytes stay corrupt) and propagates at once
+    so the newest-valid fallback walk proceeds instead of stalling.
+
     >>> mgr = CheckpointManager("/ckpts/run7", keep=3)
     >>> mgr.save(step, {"params": params, "opt": opt_state,
     ...                 "scaler": sstate, "rng": rng_key,
@@ -405,12 +429,24 @@ class CheckpointManager:
 
     root: str
     keep: int = 3
+    retry: Optional["RetryPolicy"] = None
+
+    def _retrying(self, fn, what: str):
+        if self.retry is None:
+            return fn()
+        from apex_tpu.resilience.retry import retry_transient
+
+        return retry_transient(fn, policy=self.retry, what=what)
 
     def save(self, step: int, tree: Any) -> str:
-        return save_checkpoint(self.root, step, tree, keep=self.keep)
+        return self._retrying(
+            lambda: save_checkpoint(self.root, step, tree, keep=self.keep),
+            "checkpoint_save")
 
     def restore(self, like: Any, *, step: Optional[int] = None):
-        return restore_checkpoint(self.root, like, step=step)
+        return self._retrying(
+            lambda: restore_checkpoint(self.root, like, step=step),
+            "checkpoint_restore")
 
     def all_steps(self) -> list[int]:
         return _list_steps(self.root)

@@ -21,13 +21,27 @@ Three fault classes, matching what pod-scale training actually sees
   :meth:`truncate_checkpoint` damage checkpoint bytes on disk the way a
   preempted writer or a bad disk does, to drive the validation-fallback
   path of :mod:`apex_tpu.resilience.checkpoint`.
+
+PR 2 adds the *supervisor-domain* faults — the quiet failures that the
+step watchdog, transient retry, and data guard exist to survive:
+
+- **Stragglers**: :class:`SlowStep` stalls the host step body at chosen
+  steps so the watchdog deadline fires deterministically.
+- **Flaky producers**: :class:`FlakyIterator` makes a chosen fetch raise
+  a transient error N times and then succeed *without consuming* the
+  underlying item — the retry path recovers the exact same stream.
+- **Corrupt records**: :class:`CorruptBatch` *inserts* a damaged copy of
+  a chosen batch ahead of the clean one (NaN / shape / dtype damage),
+  so a guarded run that skips it sees the identical clean stream as an
+  unfaulted run — trajectory comparisons stay bit-exact.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Any, Tuple
+import time
+from typing import Any, Callable, Iterable, Iterator, Tuple, Type
 
 import jax
 import jax.numpy as jnp
@@ -36,9 +50,12 @@ import numpy as np
 from apex_tpu._logging import emit_event
 
 __all__ = [
+    "CorruptBatch",
     "FaultInjector",
     "FaultPlan",
+    "FlakyIterator",
     "SimulatedPreemption",
+    "SlowStep",
 ]
 
 
@@ -157,3 +174,157 @@ class FaultInjector:
             f.truncate(max(size - drop_bytes, 0))
         emit_event("fault_injected", fault="checkpoint_truncation",
                    path=path, dropped=drop_bytes)
+
+
+# -- supervisor-domain faults (PR 2) --------------------------------------
+
+
+class SlowStep:
+    """Host-side straggler: stall the step body at configured steps.
+
+    Call ``slow(step)`` at the top of the step function — inside the
+    watchdog's armed window — to block for ``duration_s`` on the chosen
+    steps.  The computation itself is untouched (a straggler finishes,
+    late), so a run that tolerates the stall stays bit-identical to an
+    unfaulted one.  ``sleep`` is injectable for wait-free tests.
+    """
+
+    def __init__(self, steps: Iterable[int], duration_s: float = 0.3, *,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.steps = frozenset(int(s) for s in steps)
+        self.duration_s = float(duration_s)
+        self._sleep = sleep
+
+    def __call__(self, step: int) -> None:
+        if int(step) in self.steps:
+            emit_event("fault_injected", fault="slow_step", step=int(step),
+                       duration_s=self.duration_s)
+            self._sleep(self.duration_s)
+
+
+class FlakyIterator:
+    """Transiently failing producer: chosen fetches raise, then succeed.
+
+    The fetch at (0-based) index ``i`` for each ``i`` in ``fail_at``
+    raises ``exc_type`` ``failures`` times before succeeding — and the
+    failures do NOT consume the underlying item, exactly like a storage
+    frontend that errors before delivering.  A retry wrapper therefore
+    recovers the *identical* stream an unfaulted run would see.
+    """
+
+    def __init__(self, it: Iterable, *, fail_at: Iterable[int] = (),
+                 failures: int = 2,
+                 exc_type: Type[Exception] = OSError,
+                 message: str = "injected flaky fetch"):
+        if failures < 1:
+            raise ValueError(f"failures must be >= 1, got {failures}")
+        self._it = iter(it)
+        self.fail_at = frozenset(int(i) for i in fail_at)
+        self.failures = failures
+        self.exc_type = exc_type
+        self.message = message
+        self._idx = 0      # index of the next successful fetch
+        self._raised = 0   # failures already raised at the current index
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        if self._idx in self.fail_at and self._raised < self.failures:
+            self._raised += 1
+            emit_event("fault_injected", fault="flaky_iterator",
+                       index=self._idx, failure=self._raised,
+                       failures=self.failures)
+            raise self.exc_type(
+                f"{self.message} (index {self._idx}, "
+                f"failure {self._raised}/{self.failures})")
+        item = next(self._it)
+        self._idx += 1
+        self._raised = 0
+        return item
+
+
+class CorruptBatch:
+    """Insert a corrupted COPY of chosen batches ahead of the clean ones.
+
+    Insertion (rather than replacement) is the property that makes
+    recovery *testable*: a guarded run that drops every corrupted copy
+    consumes the exact clean stream an unfaulted run consumes, so their
+    trajectories must match bit for bit.  ``at`` indexes the underlying
+    clean stream (0-based).  Damage modes, applied to the first array
+    leaf on the host (seed-driven placement for ``nan``):
+
+    - ``"nan"``    — plant NaNs (spec-valid shape/dtype; finiteness check
+      must catch it),
+    - ``"shape"``  — drop the leading row,
+    - ``"dtype"``  — cast to a different dtype of the same shape.
+    """
+
+    _MODES = ("nan", "shape", "dtype")
+
+    def __init__(self, it: Iterable, *, at: Iterable[int] = (),
+                 mode: str = "nan", seed: int = 0, n_elements: int = 3):
+        if mode not in self._MODES:
+            raise ValueError(f"mode must be one of {self._MODES}, got {mode!r}")
+        self._it = iter(it)
+        self.at = frozenset(int(i) for i in at)
+        self.mode = mode
+        self.seed = seed
+        self.n_elements = n_elements
+        self._idx = 0             # clean items fetched from the source
+        self._pending = None      # clean item to deliver after its corrupt copy
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def _corrupt(self, batch: Any) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        if self.mode == "nan":
+            # NaN damage needs a floating leaf (an int leaf has no NaN a
+            # finiteness check could catch)
+            target = next(
+                (i for i, l in enumerate(leaves) if np.size(l)
+                 and np.issubdtype(np.asarray(l).dtype, np.floating)), None)
+        else:
+            target = next((i for i, l in enumerate(leaves) if np.size(l)),
+                          None)
+        if target is None:
+            # silently inserting an UNcorrupted copy would desync the
+            # stream from an unfaulted run without testing anything —
+            # surface the plan/batch mismatch instead
+            raise ValueError(
+                f"CorruptBatch(mode={self.mode!r}): batch has no "
+                f"{'floating-point ' if self.mode == 'nan' else ''}"
+                "non-empty array leaf to corrupt")
+        arr = np.array(leaves[target])  # host copy; never touch the original
+        if self.mode == "nan":
+            rng = np.random.default_rng(self.seed)
+            flat = arr.reshape(-1)
+            pos = rng.choice(flat.size,
+                             size=min(self.n_elements, flat.size),
+                             replace=False)
+            flat[pos] = np.nan
+            arr = flat.reshape(arr.shape)
+        elif self.mode == "shape":
+            arr = arr[1:] if arr.ndim and arr.shape[0] > 0 else arr.reshape(-1)
+        else:  # dtype
+            arr = arr.astype(np.float64 if arr.dtype != np.float64
+                             else np.float32)
+        leaves = list(leaves)
+        leaves[target] = arr
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def __next__(self):
+        if self._pending is not None:
+            item, self._pending = self._pending, None
+            return item
+        item = next(self._it)
+        idx = self._idx
+        self._idx += 1
+        if idx in self.at:
+            corrupted = self._corrupt(item)  # before touching _pending
+            emit_event("fault_injected", fault="corrupt_batch", index=idx,
+                       mode=self.mode)
+            self._pending = item
+            return corrupted
+        return item

@@ -1,0 +1,534 @@
+"""Training supervisor: step watchdog, heartbeat, escalation, clean abort.
+
+PR 1's resilience machinery handles the failures that *announce*
+themselves — corrupt checkpoints, NaN gradients, preemption signals.
+The failures that dominate at pod scale are quieter (PAPERS.md:
+"Exploring the limits of Concurrency in ML Training on Google TPUs";
+MLPerf TPU-v3 pod runs): a step that silently never finishes, a
+straggling host, an input pipeline that hangs or rots.  This module is
+the host-side layer that turns those into *events with deadlines*:
+
+- :class:`StepWatchdog` — a per-step deadline on a monotonic clock.
+  ``arm``/``disarm`` bracket each step (or ``with watchdog.step(i):``);
+  a background monitor thread notices a stall mid-step and dumps
+  structured diagnostics (step, heartbeat age, pipeline timer snapshot,
+  live-array count) through ``emit_event`` while the step is still
+  stuck — the information an engineer needs *before* the job is killed.
+  ``disarm`` raises :class:`StepDeadlineExceeded` for slow-but-finished
+  steps, so deadline violations are deterministic control flow, not just
+  log lines.
+- **Heartbeat file** — ``beat`` atomically rewrites a small JSON file
+  (step, wall/monotonic time, newest checkpoint path) that an external
+  orchestrator can watch: "mtime stopped advancing" is the universal
+  pod-level liveness probe, and the checkpoint path tells the restart
+  where to resume from without parsing logs.
+- :class:`TrainingSupervisor` — the escalation policy tying the pieces
+  together: transient data-fetch failures are retried
+  (:func:`~apex_tpu.resilience.retry.retry_transient`), corrupt batches
+  are skipped within the guard's budget, and *unrecovered* step-level
+  failures (deadline blown, retry exhausted, skip budget exceeded, data
+  stall) feed a consecutive-failure counter.  At
+  ``max_consecutive_failures`` the supervisor degrades gracefully:
+  write an emergency checkpoint through PR 1's validated atomic
+  machinery, prove it good, record it in the heartbeat, and raise
+  :class:`TrainingAborted` — the run dies *clean and resumable* instead
+  of wedged or half-written.
+
+Everything is deterministic under test: the clock, sleeper, and fault
+sources are injectable, and tier-1 drives every path on CPU
+(``tests/test_supervisor.py``) with no sleep longer than ~1 s.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    validate_checkpoint,
+)
+from apex_tpu.resilience.data_guard import DataStallError, SkipBudgetExceeded
+from apex_tpu.resilience.retry import (
+    RetryExhausted,
+    RetryPolicy,
+    retry_transient,
+)
+
+__all__ = [
+    "StepDeadlineExceeded",
+    "StepWatchdog",
+    "SupervisorConfig",
+    "TrainingAborted",
+    "TrainingSupervisor",
+    "read_heartbeat",
+    "write_heartbeat",
+]
+
+logger = get_logger("resilience.supervisor")
+
+
+class StepDeadlineExceeded(RuntimeError):
+    """A training step outlived its deadline (straggler or hang).
+
+    Carries ``step``, ``deadline_s``, ``elapsed_s`` and the
+    ``diagnostics`` dict dumped with the ``watchdog_stall`` event.
+    """
+
+    def __init__(self, step: int, deadline_s: float, elapsed_s: float,
+                 diagnostics: Optional[dict] = None):
+        super().__init__(
+            f"step {step} exceeded its {deadline_s:.3f}s deadline "
+            f"({elapsed_s:.3f}s elapsed)")
+        self.step = step
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+        self.diagnostics = diagnostics or {}
+
+
+class TrainingAborted(RuntimeError):
+    """Clean abort after graceful degradation: the emergency checkpoint
+    (``checkpoint_path``, when one could be written) is validated and
+    resumable — restart from it."""
+
+    def __init__(self, reason: str, step: int,
+                 checkpoint_path: Optional[str] = None):
+        super().__init__(
+            f"training aborted at step {step}: {reason}"
+            + (f" (emergency checkpoint: {checkpoint_path})"
+               if checkpoint_path else " (no emergency checkpoint written)"))
+        self.reason = reason
+        self.step = step
+        self.checkpoint_path = checkpoint_path
+
+
+def write_heartbeat(path: str, step: int, *,
+                    ckpt_path: Optional[str] = None,
+                    stalled: bool = False) -> dict:
+    """Atomically rewrite the heartbeat file; returns the payload.
+
+    Same crash-safety move as the checkpoint writer (temp + ``os.replace``):
+    a watcher never reads a half-written heartbeat.  ``monotonic`` rides
+    along so in-process readers can compute stall-safe ages; external
+    watchers use mtime / ``time``.
+    """
+    payload = {
+        "step": int(step),
+        "time": time.time(),
+        "monotonic": time.monotonic(),
+        "pid": os.getpid(),
+        "ckpt_path": ckpt_path,
+        "stalled": bool(stalled),
+    }
+    # thread ident in the temp name: the monitor thread (stall marker)
+    # and the main thread (beat) share a pid and may write concurrently —
+    # each needs its own temp file for os.replace to stay atomic
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return payload
+
+
+def read_heartbeat(path: str) -> dict:
+    """Parse a heartbeat file (the watcher side of :func:`write_heartbeat`)."""
+    with open(path) as f:
+        return json.load(f)
+
+
+class StepWatchdog:
+    """Per-step deadline on a monotonic clock, with a monitor thread.
+
+    Synchronous contract: ``arm(step)`` at step start, ``disarm()`` at
+    step end — ``disarm`` raises :class:`StepDeadlineExceeded` when the
+    deadline was blown (the straggler case: the step *finished*, late).
+    Asynchronous contract: ``start()`` spawns a daemon monitor thread
+    that polls the armed step and, the moment a stall crosses the
+    deadline, dumps diagnostics via a ``watchdog_stall`` event, marks
+    the heartbeat file ``stalled``, and invokes ``on_stall`` (the hook
+    for ``_thread.interrupt_main`` or an orchestrator RPC) — so a truly
+    hung step still leaves evidence even though no Python thread can
+    unwedge it.  ``arm``/``disarm`` are single attribute swaps (atomic
+    under the GIL): the per-step overhead is nanoseconds, measured by
+    bench.py's ``supervisor`` block.
+    """
+
+    def __init__(self, deadline_s: float, *,
+                 heartbeat_path: Optional[str] = None,
+                 timers=None,
+                 poll_interval_s: Optional[float] = None,
+                 on_stall: Optional[Callable[[dict], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if deadline_s <= 0.0:
+            raise ValueError(f"deadline_s must be positive, got {deadline_s}")
+        if poll_interval_s is not None and poll_interval_s <= 0.0:
+            raise ValueError(
+                f"poll_interval_s must be positive, got {poll_interval_s}")
+        self.deadline_s = deadline_s
+        self.heartbeat_path = heartbeat_path
+        self.timers = timers
+        self.poll_interval_s = (poll_interval_s if poll_interval_s is not None
+                                else min(max(deadline_s / 4.0, 0.01), 10.0))
+        self.on_stall = on_stall
+        self._clock = clock
+        self._armed: Optional[Tuple[int, float]] = None  # (step, t0) swap
+        self._stall: Optional[dict] = None  # monitor-observed diagnostics
+        self._last_beat: Optional[Tuple[int, float]] = None
+        self._last_ckpt_path: Optional[str] = None  # newest known checkpoint
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- monitor lifecycle -------------------------------------------------
+
+    def start(self) -> "StepWatchdog":
+        """Spawn the monitor thread (idempotent)."""
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._monitor, name="apex-step-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop and join the monitor thread (idempotent)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(self.poll_interval_s * 4, 1.0))
+            self._thread = None
+
+    def __enter__(self) -> "StepWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- per-step bracket --------------------------------------------------
+
+    def arm(self, step: int) -> None:
+        """Start the deadline for ``step`` (one attribute swap)."""
+        self._stall = None
+        self._armed = (int(step), self._clock())
+
+    def cancel(self) -> None:
+        """Clear the armed step without a deadline check (use when the
+        step body raised for an unrelated reason — don't double-report)."""
+        self._armed = None
+        self._stall = None
+
+    def disarm(self) -> None:
+        """End the armed step; raises :class:`StepDeadlineExceeded` when
+        the step overran its deadline (or the monitor already saw it)."""
+        armed, self._armed = self._armed, None
+        if armed is None:
+            raise RuntimeError("disarm() without a matching arm()")
+        step, t0 = armed
+        elapsed = self._clock() - t0
+        stall = self._stall
+        self._stall = None
+        if stall is not None and stall.get("step") != step:
+            # the monitor raced arm(): it observed the PREVIOUS step's
+            # stall and stored it after arm() cleared the slot — that
+            # step already raised at its own disarm; not this step's miss
+            stall = None
+        if stall is None and elapsed <= self.deadline_s:
+            return
+        diag = stall or self._diagnostics(step, elapsed)
+        if stall is None:
+            # the monitor did not get there first (tight deadline or no
+            # thread running): this is the one report for the step
+            emit_event("watchdog_stall", **diag)
+        raise StepDeadlineExceeded(step, self.deadline_s, elapsed, diag)
+
+    @contextlib.contextmanager
+    def step(self, step: int):
+        """``with watchdog.step(i): ...`` — arm/disarm bracket that does
+        not double-fire when the body raises on its own."""
+        self.arm(step)
+        try:
+            yield self
+        except BaseException:
+            self.cancel()
+            raise
+        self.disarm()
+
+    # -- heartbeat ---------------------------------------------------------
+
+    def beat(self, step: int, *, ckpt_path: Optional[str] = None) -> None:
+        """Record liveness (and optionally the newest checkpoint path);
+        rewrites the heartbeat file when one is configured.  The
+        checkpoint path is *sticky*: a ``beat`` without one re-publishes
+        the newest path seen, so the heartbeat's resume pointer survives
+        the (majority of) steps that don't save.  A heartbeat write
+        failure is logged, never fatal — losing the liveness probe must
+        not kill the run the probe exists to protect."""
+        self._last_beat = (int(step), self._clock())
+        if ckpt_path is not None:
+            self._last_ckpt_path = ckpt_path
+        if self.heartbeat_path is None:
+            return
+        try:
+            write_heartbeat(self.heartbeat_path, step,
+                            ckpt_path=self._last_ckpt_path)
+        except OSError as e:
+            logger.warning("heartbeat write to %s failed: %s",
+                           self.heartbeat_path, e)
+
+    # -- diagnostics -------------------------------------------------------
+
+    def _diagnostics(self, step: int, elapsed_s: float) -> dict:
+        """The stall dump: everything a post-mortem needs that vanishes
+        with the process."""
+        beat_age = None
+        if self._last_beat is not None:
+            beat_age = round(self._clock() - self._last_beat[1], 3)
+        diag = {
+            "step": int(step),
+            "deadline_s": self.deadline_s,
+            "elapsed_s": round(elapsed_s, 3),
+            "heartbeat_age_s": beat_age,
+        }
+        try:
+            import jax
+
+            diag["live_arrays"] = len(jax.live_arrays())
+        except Exception as e:  # diagnostics must never mask the stall
+            diag["live_arrays"] = f"unavailable: {type(e).__name__}"
+        if self.timers is not None:
+            try:
+                diag["timers"] = self.timers.snapshot()
+            except Exception as e:
+                diag["timers"] = f"unavailable: {type(e).__name__}"
+        return diag
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            armed = self._armed
+            if armed is None or self._stall is not None:
+                continue
+            step, t0 = armed
+            elapsed = self._clock() - t0
+            if elapsed <= self.deadline_s:
+                continue
+            diag = self._diagnostics(step, elapsed)
+            # heartbeat BEFORE the event: anything watching the event
+            # stream may react immediately and must find the stall marker
+            if self.heartbeat_path is not None:
+                try:
+                    write_heartbeat(self.heartbeat_path, step,
+                                    ckpt_path=self._last_ckpt_path,
+                                    stalled=True)
+                except OSError as e:
+                    logger.warning("stall heartbeat write failed: %s", e)
+            emit_event("watchdog_stall", **diag)
+            self._stall = diag  # one report per armed step
+            if self.on_stall is not None:
+                self.on_stall(diag)
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Escalation policy knobs.
+
+    ``step_deadline_s`` bounds one step (watchdog).  ``checkpoint_every``
+    is the periodic-save interval in steps (the final step always saves).
+    ``max_consecutive_failures`` is the graceful-degradation trigger:
+    that many *unrecovered* failures in a row write an emergency
+    checkpoint and abort cleanly.  ``retry`` governs every host-I/O
+    retry (data fetch, checkpoint save)."""
+
+    step_deadline_s: float = 1800.0
+    poll_interval_s: Optional[float] = None
+    max_consecutive_failures: int = 3
+    checkpoint_every: int = 1
+    heartbeat_path: Optional[str] = None
+    retry: RetryPolicy = RetryPolicy()
+
+    def __post_init__(self):
+        if self.step_deadline_s <= 0.0:
+            raise ValueError("step_deadline_s must be positive")
+        if self.max_consecutive_failures < 1:
+            raise ValueError("max_consecutive_failures must be >= 1")
+        if self.checkpoint_every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+
+
+class TrainingSupervisor:
+    """Supervised host loop: watchdog + retry + skip budget + escalation.
+
+    ``run(step_fn, state, batches, num_steps=...)`` drives
+    ``step_fn(state, batch, step) -> state`` over ``batches`` (wrap them
+    in a :class:`~apex_tpu.resilience.data_guard.GuardedIterator` for
+    validation/skip semantics), with:
+
+    - every batch fetch retried under ``config.retry`` (transient
+      producer errors cost attempts, not the run);
+    - every step bracketed by the watchdog;
+    - a heartbeat + periodic validated checkpoint after each step;
+    - an escalating consecutive-failure counter over the supervisor's
+      failure domain (:class:`StepDeadlineExceeded`,
+      :class:`~apex_tpu.resilience.retry.RetryExhausted`,
+      :class:`~apex_tpu.resilience.data_guard.SkipBudgetExceeded`,
+      :class:`~apex_tpu.resilience.data_guard.DataStallError`) — any
+      other exception is not the supervisor's to absorb and propagates.
+
+    A slow-but-finished step keeps its result (the work is real) but
+    counts as a failure; escalation therefore checkpoints the *newest*
+    state, and a restart resumes bit-identically
+    (``tests/test_supervisor.py`` acceptance run).
+    """
+
+    FAILURE_DOMAIN = (StepDeadlineExceeded, RetryExhausted,
+                      SkipBudgetExceeded, DataStallError)
+
+    def __init__(self, manager: Optional[CheckpointManager] = None,
+                 config: SupervisorConfig = SupervisorConfig(), *,
+                 timers=None,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.manager = manager
+        self.config = config
+        self.consecutive_failures = 0
+        self._sleep = sleep
+        self.watchdog = StepWatchdog(
+            config.step_deadline_s,
+            heartbeat_path=config.heartbeat_path,
+            timers=timers,
+            poll_interval_s=config.poll_interval_s,
+            clock=clock)
+
+    # -- failure accounting / graceful degradation -------------------------
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+
+    def record_failure(self, step: int, state: Any, exc: BaseException, *,
+                       completed_step: Optional[int] = None) -> None:
+        """Count one unrecovered failure; escalate at the threshold.
+
+        ``completed_step`` is the step whose completion produced
+        ``state`` and therefore labels any emergency checkpoint; it
+        defaults to ``step``.  A fetch-time failure passes the PREVIOUS
+        step here — ``state`` predates ``step``, and labeling the
+        checkpoint ``step`` would make a resume at ``step + 1`` silently
+        skip the step that never ran."""
+        self.consecutive_failures += 1
+        emit_event("supervisor_failure", step=int(step),
+                   failure=type(exc).__name__, error=str(exc)[:500],
+                   consecutive=self.consecutive_failures,
+                   max_consecutive=self.config.max_consecutive_failures)
+        if self.consecutive_failures >= self.config.max_consecutive_failures:
+            self.escalate(step, state,
+                          reason=f"{self.consecutive_failures} consecutive "
+                                 f"failures (last: {type(exc).__name__})",
+                          completed_step=completed_step)
+
+    def escalate(self, step: int, state: Any, *, reason: str,
+                 completed_step: Optional[int] = None) -> None:
+        """Graceful degradation: emergency checkpoint, then clean abort.
+
+        The checkpoint is written through the validated atomic machinery
+        (with transient-I/O retries) and re-validated before the abort is
+        raised; if even that fails, the abort still happens — carrying
+        the error — because a wedged process is worse than a lost
+        checkpoint interval.
+        """
+        ckpt_step = step if completed_step is None else completed_step
+        path, ckpt_error = None, None
+        if self.manager is not None:
+            try:
+                path = self._checkpoint(ckpt_step, state,
+                                        what="emergency_checkpoint")
+                validate_checkpoint(path)
+            except (RetryExhausted, CheckpointError, OSError) as e:
+                ckpt_error = f"{type(e).__name__}: {e}"
+        emit_event("supervisor_abort", step=int(step), reason=reason,
+                   checkpoint=path, checkpoint_error=ckpt_error)
+        self.watchdog.beat(step, ckpt_path=path)
+        raise TrainingAborted(reason, int(step), path)
+
+    # -- the supervised loop ----------------------------------------------
+
+    def _next_batch(self, it) -> Any:
+        return retry_transient(lambda: next(it), policy=self.config.retry,
+                               what="data_fetch", sleep=self._sleep)
+
+    def _checkpoint(self, step: int, state: Any, *,
+                    what: str = "checkpoint_save") -> Optional[str]:
+        """One retried save.  A manager constructed with its own
+        ``retry`` policy already wraps ``save`` in ``retry_transient``
+        (the documented recipe does exactly that) — defer to it rather
+        than nesting two loops into ``max_attempts**2`` save attempts."""
+        if self.manager.retry is not None:
+            return self.manager.save(int(step), state)
+        return retry_transient(
+            lambda: self.manager.save(int(step), state),
+            policy=self.config.retry, what=what,
+            sleep=self._sleep)
+
+    def run(self, step_fn: Callable[[Any, Any, int], Any], state: Any,
+            batches: Iterable, *, num_steps: int,
+            start_step: int = 0) -> Tuple[Any, int]:
+        """Drive ``step_fn`` for steps ``[start_step, num_steps)``.
+
+        Returns ``(state, last_completed_step)`` — ``start_step - 1``
+        when no step completed (e.g. the iterator was empty).  Raises
+        :class:`TrainingAborted` on escalation; exceptions outside the
+        supervisor's failure domain propagate unchanged.
+        """
+        it = iter(batches)
+        step = int(start_step)
+        last_completed = step - 1
+        self.watchdog.start()
+        try:
+            while step < num_steps:
+                # -- fetch (retried; guard skips ride inside the iterator)
+                try:
+                    batch = self._next_batch(it)
+                except StopIteration:
+                    break
+                except self.FAILURE_DOMAIN as e:
+                    # state predates `step` (its fetch failed): any
+                    # emergency checkpoint must carry the completed label
+                    self.record_failure(step, state, e,
+                                        completed_step=last_completed)
+                    continue  # re-attempt the same step number
+
+                # -- the step itself, under the deadline
+                self.watchdog.arm(step)
+                try:
+                    new_state = step_fn(state, batch, step)
+                except BaseException:
+                    self.watchdog.cancel()  # not a deadline event
+                    raise
+                try:
+                    self.watchdog.disarm()
+                    self.record_success()
+                except StepDeadlineExceeded as e:
+                    # late but finished: keep the result, count the miss
+                    self.record_failure(step, new_state, e)  # may abort
+                state = new_state
+                last_completed = step
+
+                # -- commit host-side progress
+                ckpt_path = None
+                if self.manager is not None and (
+                        (step + 1) % self.config.checkpoint_every == 0
+                        or step + 1 >= num_steps):
+                    try:
+                        ckpt_path = self._checkpoint(step, state)
+                    except RetryExhausted as e:
+                        self.record_failure(step, state, e)  # may abort
+                self.watchdog.beat(step, ckpt_path=ckpt_path)
+                step += 1
+            return state, last_completed
+        finally:
+            self.watchdog.stop()

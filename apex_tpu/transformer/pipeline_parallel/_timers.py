@@ -39,16 +39,21 @@ class _Timer:
             raise RuntimeError(f"timer {self.name!r} is already running")
         if barrier:
             jax.effects_barrier()
-        self.running = True
+        # _t0 before running: a concurrent snapshot() that observes
+        # running=True must never pair it with the PREVIOUS region's t0
         self._t0 = time.perf_counter()
+        self.running = True
 
     def stop(self, barrier: bool = False) -> None:
         if not self.running:
             raise RuntimeError(f"timer {self.name!r} was never started")
         if barrier:
             jax.effects_barrier()
-        self.total += time.perf_counter() - self._t0
+        # running=False before total+=: a concurrent snapshot() that
+        # already read total must not ALSO add the in-flight span
+        elapsed = time.perf_counter() - self._t0
         self.running = False
+        self.total += elapsed
 
     @contextlib.contextmanager
     def timing(self, barrier: bool = False) -> Iterator["_Timer"]:
@@ -94,6 +99,29 @@ class Timers:
         except KeyError:
             t = self._timers[name] = _Timer(name)
             return t
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Point-in-time, NON-destructive view of every timer.
+
+        ``{name: {"total_s": accumulated+in-flight seconds, "running":
+        bool}}``.  Unlike :meth:`_Timer.elapsed` this mutates nothing —
+        it is the read the step watchdog's monitor thread takes while
+        the main thread is stuck *inside* a timed region, so a running
+        timer's in-flight seconds are included and values may be one
+        assignment stale (harmless for diagnostics).
+        """
+        now = time.perf_counter()
+        out: Dict[str, dict] = {}
+        for name, t in list(self._timers.items()):
+            # read total BEFORE running: paired with stop()'s
+            # running=False-then-total+= ordering, a racing stop can make
+            # this view one span stale but never double-counted
+            total = t.total
+            running = t.running
+            if running:
+                total += now - t._t0
+            out[name] = {"total_s": round(total, 6), "running": running}
+        return out
 
     def write(self, names: List[str], writer, iteration: int,
               normalizer: float = 1.0, reset: bool = False) -> None:

@@ -12,6 +12,7 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 
+from apex_tpu._logging import get_logger
 from apex_tpu.transformer.microbatches import build_num_microbatches_calculator
 from apex_tpu.transformer.parallel_state import DATA_PARALLEL_AXIS
 
@@ -165,10 +166,20 @@ def calc_params_l2_norm(params, across_model_parallel: bool = True):
 
 
 def report_memory(name: str) -> str:
-    """utils.py:253 report_memory — TPU HBM stats via device memory stats."""
+    """utils.py:253 report_memory — TPU HBM stats via device memory stats.
+
+    Backends without memory stats (CPU returns ``None``; some plugins
+    raise) degrade to zeros — but say so at debug level instead of
+    silently reporting an empty host as healthy.
+    """
     try:
         stats = jax.local_devices()[0].memory_stats() or {}
-    except Exception:
+    except (RuntimeError, NotImplementedError, IndexError) as e:
+        # RuntimeError covers XlaRuntimeError (backend not initialized /
+        # plugin without the API); IndexError = no local devices at all
+        get_logger("transformer.pipeline_parallel.utils").debug(
+            "memory_stats unavailable on this backend: %s: %s",
+            type(e).__name__, e)
         stats = {}
     used = stats.get("bytes_in_use", 0) / 2**30
     peak = stats.get("peak_bytes_in_use", 0) / 2**30

@@ -118,10 +118,13 @@ _CONFIGS = {
 # XLA failure text and burned the retry budget on hard errors).
 # RESOURCE_EXHAUSTED (OOM) is deliberately NOT here: it is deterministic,
 # and the right move is the next-smaller config, not a retry.
-_TRANSIENT_MARKERS = (
-    "remote_compile", "INTERNAL:", "UNAVAILABLE:", "DEADLINE_EXCEEDED",
-    "Socket closed", "Connection reset", "Stream removed",
-)
+# The shared set lives on resilience.retry.RetryPolicy; "INTERNAL:" is
+# tunnel-only on top of it (deterministic XLA internal errors also match
+# that prefix — acceptable only here, where every error arrives through
+# the tunnel).  Imported lazily to keep bench importable apex-free.
+def _transient_markers() -> tuple:
+    from apex_tpu.resilience.retry import RetryPolicy
+    return ("INTERNAL:",) + RetryPolicy.transient_markers
 
 
 def _peak_tflops(device) -> float:
@@ -187,6 +190,11 @@ def _run_external(name: str, *, batch, steps, seq) -> dict:
     return r
 
 
+# Diagnostic blocks riding every captured config: ``recovery`` (checkpoint
+# save/validate/restore on the live train state, below) and ``supervisor``
+# (_supervisor_metrics: watchdog arm/disarm, heartbeat write, retry path)
+# keep the robustness tax visible in the BENCH trajectory.
+
 # resilience-overhead capture: checkpointing the full 774M train state
 # (~9 GB with optimizer moments) through the tunnel would dominate the
 # bench deadline, so the measured tree is capped — leaves are taken in
@@ -245,6 +253,56 @@ def _recovery_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET) -> dict:
         "restore_ms": round(t_restore * 1e3, 2),
         "save_mb_per_s": round(total / 2**20 / max(t_save, 1e-9), 1),
         "restore_mb_per_s": round(total / 2**20 / max(t_restore, 1e-9), 1),
+    }
+
+
+def _supervisor_metrics(n: int = 2000) -> dict:
+    """Robustness tax of the ISSUE-2 supervisor layer (the BENCH_*.json
+    ``supervisor`` block): per-step watchdog arm/disarm cost, heartbeat
+    write latency, and the classification+event overhead of a 2-failure
+    transient retry (sleeps zeroed — the backoff wait is policy, not
+    tax).  Pure host-side; never touches the device."""
+    import tempfile
+
+    from apex_tpu.resilience import retry as rtry
+    from apex_tpu.resilience import supervisor as sup
+
+    wd = sup.StepWatchdog(deadline_s=3600.0, poll_interval_s=600.0)
+    t0 = time.perf_counter()
+    for i in range(n):
+        wd.arm(i)
+        wd.disarm()
+    arm_disarm_us = (time.perf_counter() - t0) / n * 1e6
+
+    with tempfile.TemporaryDirectory(prefix="bench_supervisor_") as d:
+        hb = os.path.join(d, "heartbeat.json")
+        n_hb = 50
+        t0 = time.perf_counter()
+        for i in range(n_hb):
+            sup.write_heartbeat(hb, i, ckpt_path="/ckpts/step_0000000042")
+        heartbeat_ms = (time.perf_counter() - t0) / n_hb * 1e3
+
+    policy = rtry.RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) % 3:
+            raise OSError("injected transient")
+        return True
+
+    n_retry = 20
+    t0 = time.perf_counter()
+    for _ in range(n_retry):
+        rtry.retry_transient(flaky, policy=policy, what="bench_retry",
+                             sleep=lambda s: None)
+    retry_ms = (time.perf_counter() - t0) / n_retry * 1e3
+
+    return {
+        "ok": True,
+        "watchdog_arm_disarm_us_per_step": round(arm_disarm_us, 3),
+        "heartbeat_write_ms": round(heartbeat_ms, 3),
+        "retry_2fail_recovered_ms": round(retry_ms, 3),
     }
 
 
@@ -393,6 +451,10 @@ def run_config(name: str, *, batch: int | None = None,
         recovery = _recovery_metrics({"params": params, "opt": opt_state})
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         recovery = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        supervisor = _supervisor_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        supervisor = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -404,6 +466,7 @@ def run_config(name: str, *, batch: int | None = None,
         "n_chips": n_chips,
         "device": str(dev.device_kind),
         "recovery": recovery,
+        "supervisor": supervisor,
         "config": out_cfg,
     }
 
@@ -438,7 +501,8 @@ def _capture_chain(chain: list[str], *, batch: int | None, steps: int | None,
                 # errors (OOM, shape bugs) are deterministic, so burn no
                 # budget re-proving that: jump straight to the next config
                 transient = (isinstance(e, AssertionError)
-                             or any(m in str(e) for m in _TRANSIENT_MARKERS))
+                             or any(m in str(e)
+                                    for m in _transient_markers()))
                 try:
                     jax.clear_caches()
                 except Exception:

@@ -1,0 +1,130 @@
+"""Edge cases of the DP-sharded batch samplers (ISSUE 2 satellite).
+
+The samplers feed the data path the supervisor guards; their wraparound,
+``drop_last``, and invalid-argument behavior was previously untested
+robustness surface (`transformer/_data/_batchsampler.py`).
+"""
+
+import numpy as np
+import pytest
+
+from apex_tpu.transformer._data._batchsampler import (
+    MegatronPretrainingRandomSampler,
+    MegatronPretrainingSampler,
+)
+
+
+class TestSequentialSampler:
+    def test_basic_sharding(self):
+        # 8 samples, mbs 2, dp 2: each global batch of 4 is split by rank
+        r0 = list(MegatronPretrainingSampler(8, 0, 2, 0, 2))
+        r1 = list(MegatronPretrainingSampler(8, 0, 2, 1, 2))
+        assert r0 == [[0, 1], [4, 5]]
+        assert r1 == [[2, 3], [6, 7]]
+
+    def test_consumed_resumes_mid_stream(self):
+        got = list(MegatronPretrainingSampler(8, 4, 2, 0, 1))
+        assert got == [[4, 5], [6, 7]]
+
+    def test_consumed_at_total_yields_nothing(self):
+        """Wraparound edge: consumed_samples == total_samples is a
+        completed pass — the iterator is empty, not an error."""
+        assert list(MegatronPretrainingSampler(8, 8, 2, 0, 1)) == []
+
+    def test_consumed_beyond_total_yields_nothing(self):
+        assert list(MegatronPretrainingSampler(8, 12, 2, 0, 1)) == []
+
+    def test_drop_last_true_drops_ragged_tail(self):
+        # 10 samples, global batch 4: the 2-sample tail vanishes
+        got = list(MegatronPretrainingSampler(10, 0, 4, 0, 1,
+                                              drop_last=True))
+        assert got == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_drop_last_false_yields_ragged_tail(self):
+        got = list(MegatronPretrainingSampler(10, 0, 4, 0, 1,
+                                              drop_last=False))
+        assert got[-1] == [8, 9]
+        assert got[:-1] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+    def test_drop_last_false_tail_is_rank_sliced(self):
+        """The ragged tail is sliced by the SAME rank window as full
+        batches: rank 0 takes the head, a later rank whose window lies
+        beyond the tail gets an empty batch (reference parity — the
+        consumer must tolerate it)."""
+        r0 = list(MegatronPretrainingSampler(10, 8, 2, 0, 2,
+                                             drop_last=False))
+        r1 = list(MegatronPretrainingSampler(10, 8, 2, 1, 2,
+                                             drop_last=False))
+        assert r0 == [[8, 9]]
+        assert r1 == [[]]
+
+    @pytest.mark.parametrize("kwargs,match", [
+        (dict(total_samples=0), "no sample to consume"),
+        (dict(total_samples=-3), "no sample to consume"),
+        (dict(micro_batch_size=0), "micro_batch_size"),
+        (dict(data_parallel_size=0), "data parallel size"),
+        (dict(data_parallel_rank=2), "smaller than data size"),
+        (dict(data_parallel_rank=5, data_parallel_size=2),
+         "smaller than data size"),
+    ])
+    def test_invalid_arguments_raise_runtime_error(self, kwargs, match):
+        base = dict(total_samples=8, consumed_samples=0, micro_batch_size=2,
+                    data_parallel_rank=0, data_parallel_size=2)
+        base.update(kwargs)
+        with pytest.raises(RuntimeError, match=match):
+            MegatronPretrainingSampler(**base)
+
+
+class TestRandomSampler:
+    def test_epoch_covers_bucket_exactly_once(self):
+        s = MegatronPretrainingRandomSampler(8, 0, 2, 0, 1)
+        batches = list(s)
+        assert all(len(b) == 2 for b in batches)
+        assert sorted(i for b in batches for i in b) == list(range(8))
+
+    def test_wraparound_reshuffles_next_epoch(self):
+        """consumed_samples >= active total wraps into epoch 1: same
+        index set, deterministic but different order than epoch 0."""
+        epoch0 = [i for b in MegatronPretrainingRandomSampler(8, 0, 2, 0, 1)
+                  for i in b]
+        epoch1 = [i for b in MegatronPretrainingRandomSampler(8, 8, 2, 0, 1)
+                  for i in b]
+        again = [i for b in MegatronPretrainingRandomSampler(8, 8, 2, 0, 1)
+                 for i in b]
+        assert sorted(epoch0) == sorted(epoch1) == list(range(8))
+        assert epoch1 == again            # deterministic per epoch
+        assert epoch0 != epoch1           # epoch seeds the shuffle
+
+    def test_mid_epoch_resume_skips_consumed(self):
+        full = [i for b in MegatronPretrainingRandomSampler(8, 0, 2, 0, 1)
+                for i in b]
+        resumed = [i for b in MegatronPretrainingRandomSampler(8, 4, 2, 0, 1)
+                   for i in b]
+        assert resumed == full[4:]  # same permutation, offset past consumed
+
+    def test_rank_buckets_are_disjoint(self):
+        r0 = {i for b in MegatronPretrainingRandomSampler(16, 0, 2, 0, 2)
+              for i in b}
+        r1 = {i for b in MegatronPretrainingRandomSampler(16, 0, 2, 1, 2)
+              for i in b}
+        assert r0.isdisjoint(r1)
+        assert sorted(r0 | r1) == list(range(16))
+
+    def test_consumed_not_multiple_of_global_batch_asserts(self):
+        s = MegatronPretrainingRandomSampler(8, 3, 2, 0, 1)
+        with pytest.raises(AssertionError):
+            iter(s).__next__()
+
+    def test_ragged_total_drops_last_batch_size(self):
+        """total % global-batch leftover is excluded from every epoch
+        (last_batch_size semantics): 10 % 4 = 2 indices never appear."""
+        s = MegatronPretrainingRandomSampler(10, 0, 4, 0, 1)
+        seen = [i for b in s for i in b]
+        assert len(seen) == 8
+        assert set(seen) <= set(range(8))  # bucket excludes the ragged tail
+
+    def test_invalid_arguments_raise_runtime_error(self):
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingRandomSampler(0, 0, 2, 0, 1)
+        with pytest.raises(RuntimeError):
+            MegatronPretrainingRandomSampler(8, 0, 2, 3, 2)

@@ -218,6 +218,20 @@ def test_recovery_metrics_block():
     assert r3["bytes"] <= 256
 
 
+def test_supervisor_metrics_block():
+    """The robustness-tax block (ISSUE 2 satellite): watchdog arm/disarm
+    per-step cost, heartbeat write latency, and the 2-failure transient
+    retry path — host-only, sleeps zeroed."""
+    r = bench._supervisor_metrics(n=200)
+    assert r["ok"] is True
+    for k in ("watchdog_arm_disarm_us_per_step", "heartbeat_write_ms",
+              "retry_2fail_recovered_ms"):
+        assert r[k] > 0.0, k
+    # arm/disarm is attribute swaps: if it ever costs more than 1 ms a
+    # step, the watchdog became part of the problem it measures
+    assert r["watchdog_arm_disarm_us_per_step"] < 1000.0
+
+
 def test_cpu_smoke_end_to_end(monkeypatch):
     """The real measurement path on the real (CPU) backend.
 
@@ -234,3 +248,6 @@ def test_cpu_smoke_end_to_end(monkeypatch):
                 raise
     assert result["value"] > 0
     assert result["config"]["loss_end"] < result["config"]["loss0"]
+    # the diagnostic blocks ride every captured config
+    assert result["recovery"]["ok"] is True
+    assert result["supervisor"]["ok"] is True

@@ -25,10 +25,12 @@ MUST_MENTION = {
     "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline",
                "vit_l16", "llama2_7b"],
     "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
-    # the prologue (checkpoint format / recovery semantics) plus the
-    # introspected API must both be present
+    # the prologue (checkpoint format / recovery semantics / supervisor
+    # sections) plus the introspected API must both be present
     "resilience": ["CheckpointManager", "FaultInjector", "make_guarded_step",
-                   "manifest.json", "crc32", "SimulatedPreemption"],
+                   "manifest.json", "crc32", "SimulatedPreemption",
+                   "StepWatchdog", "TrainingSupervisor", "retry_transient",
+                   "GuardedIterator", "heartbeat", "FlakyIterator"],
     "utils": ["tree_to_host_dict", "emit_event"],
 }
 

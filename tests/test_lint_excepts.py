@@ -1,0 +1,87 @@
+"""tools/check_excepts.py wired into tier-1: no NEW silent broad-except
+swallowing lands without either a trace (log/raise/store) or a conscious
+allowlist entry (ISSUE 2 satellite)."""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import check_excepts  # noqa: E402
+
+
+def test_repo_has_no_silent_broad_excepts():
+    violations = check_excepts.find_violations()
+    assert violations == [], (
+        "silent broad except handlers (log, narrow, or allowlist them): "
+        f"{violations}")
+
+
+def test_allowlist_has_no_stale_entries():
+    """Every allowlist entry must still match a real broad-and-silent
+    handler — the list can only shrink or be consciously re-justified."""
+    assert check_excepts.stale_allowlist() == []
+
+
+def _scan_source(tmp_path, source):
+    path = tmp_path / "sample.py"
+    path.write_text(textwrap.dedent(source))
+    return check_excepts._scan_file(str(path))
+
+
+def test_lint_flags_a_seeded_swallow(tmp_path):
+    hits = _scan_source(tmp_path, """\
+        def quiet():
+            try:
+                work()
+            except Exception:
+                pass
+    """)
+    assert [(lineno, qual) for _, lineno, qual in hits] == [(4, "quiet")]
+
+
+def test_lint_flags_bare_except_and_tuple_forms(tmp_path):
+    hits = _scan_source(tmp_path, """\
+        class C:
+            def a(self):
+                try:
+                    work()
+                except:
+                    x = 1
+            def b(self):
+                try:
+                    work()
+                except (ValueError, BaseException):
+                    return None
+    """)
+    assert [qual for _, _, qual in hits] == ["C.a", "C.b"]
+
+
+def test_lint_accepts_traced_handlers(tmp_path):
+    """Logging, re-raising, narrowing, and store-forwarding all pass."""
+    hits = _scan_source(tmp_path, """\
+        def logged():
+            try:
+                work()
+            except Exception as e:
+                logger.debug("failed: %s", e)
+
+        def reraised():
+            try:
+                work()
+            except Exception as e:
+                raise RuntimeError("wrapped") from e
+
+        def narrowed():
+            try:
+                work()
+            except ValueError:
+                pass
+
+        def forwarded(self):
+            try:
+                work()
+            except BaseException as e:
+                self._error = e
+    """)
+    assert hits == []

@@ -81,6 +81,9 @@ PAGES = {
         "apex_tpu.resilience", "apex_tpu.resilience.checkpoint",
         "apex_tpu.resilience.fault_injection",
         "apex_tpu.resilience.guarded",
+        "apex_tpu.resilience.supervisor",
+        "apex_tpu.resilience.retry",
+        "apex_tpu.resilience.data_guard",
     ]),
     "utils": ("Utilities", [
         "apex_tpu.utils.nvtx", "apex_tpu.utils.packing",
@@ -228,6 +231,64 @@ skips in `GuardState`; after `GuardConfig.patience` consecutive skips it
 halves the dynamic loss-scale floor (continuing below the configured
 `min_loss_scale`) and emits a structured `loss_scale_floor_halved` event
 instead of silently looping.
+
+## Step watchdog and heartbeat
+
+`StepWatchdog(deadline_s)` puts a monotonic-clock deadline on every
+step: `arm(i)` / `disarm()` bracket the step (or `with watchdog.step(i)`),
+and `disarm` raises `StepDeadlineExceeded` when the step finished late —
+deadline violations are control flow, not log lines.  `start()` adds a
+monitor thread that notices a stall *mid-step* and dumps structured
+diagnostics (step, heartbeat age, pipeline-timer snapshot, live-array
+count) via a `watchdog_stall` event while the step is still stuck.
+`beat(step, ckpt_path=...)` atomically rewrites a small JSON heartbeat
+file (step, wall/monotonic time, newest checkpoint path) that external
+orchestrators watch: mtime stopped advancing is the universal liveness
+probe, and the recorded checkpoint path tells the restart where to
+resume — it is sticky, so beats on steps that did not save re-publish
+the newest path instead of erasing it.
+
+## Transient-failure retry
+
+`retry_transient(fn, policy=RetryPolicy(...))` is the one retry path for
+host-side I/O (checkpoint save/restore, data fetch).  Only exceptions the
+policy *classifies* as transient (by type — `OSError` family — or by a
+status-code-anchored message marker) are retried, with exponential
+backoff and **deterministic** jitter derived from `(seed, what, attempt)`
+— the same call site produces the same schedule on every run, while
+differently-seeded hosts de-synchronize their retry storms.  Every
+attempt emits a `retry_attempt` event; recovery emits `retry_recovered`;
+exhaustion raises `RetryExhausted` chaining the last error.
+`CheckpointManager(root, retry=RetryPolicy(...))` wires it under
+save/restore (a deterministic `CheckpointError` is never retried — the
+newest-valid fallback walk handles that class).
+
+## Data-pipeline guard
+
+`GuardedIterator(it, spec=spec_of(batch))` validates every batch against
+a spec (tree structure, per-leaf shape/dtype, finiteness of floating
+leaves) on the host side of the pipeline.  Corrupt batches are dropped
+with a `batch_skipped` event naming the offending leaf, up to a lifetime
+`skip_budget` — beyond it `SkipBudgetExceeded` is raised, because a
+systematically bad pipeline must not degrade into silently training on a
+fraction of the data.  A fetch slower than `stall_timeout_s` raises
+`DataStallError`.
+
+## Escalation and graceful degradation
+
+`TrainingSupervisor(manager, SupervisorConfig(...))` ties the layer
+together: `run(step_fn, state, batches, num_steps=...)` retries
+transient fetch failures, brackets every step with the watchdog, writes
+heartbeat + periodic validated checkpoints, and counts *unrecovered*
+failures (deadline blown, retry exhausted, skip budget exceeded, data
+stall).  At `max_consecutive_failures` it degrades gracefully: write an
+emergency checkpoint through the validated atomic machinery, prove it
+good, record it in the heartbeat, and raise `TrainingAborted` — the run
+dies clean and resumable instead of wedged.  Deterministic fault
+injectors (`SlowStep`, `FlakyIterator`, `CorruptBatch`) drive every one
+of these paths under tier-1 on CPU, including a full
+flaky-fetch + corrupt-batch + slow-step → abort → bit-identical-resume
+acceptance run.
 """,
 }
 
@@ -367,6 +428,47 @@ for i in range(start, num_steps):
 A checkpoint root assumes a **single writer**: in multi-controller runs
 gate `mgr.save` on `jax.process_index() == 0` (or give each process its
 own root) — concurrent saves into one root race the temp-dir sweep.
+
+Surviving hangs and flaky input — the supervised loop puts a deadline on
+every step, retries transient fetch/save I/O, skips corrupt batches
+within a budget, and degrades gracefully (emergency checkpoint + clean
+abort) when failures persist ([full page](api/resilience.md)):
+
+```python
+from apex_tpu import resilience as rz
+
+mgr = rz.CheckpointManager("/ckpts/run7", keep=3,
+                           retry=rz.RetryPolicy())      # transient-I/O retry
+sup = rz.TrainingSupervisor(mgr, rz.SupervisorConfig(
+    step_deadline_s=1800.0,              # watchdog: stall -> diagnostics
+    max_consecutive_failures=3,          # then emergency ckpt + clean abort
+    heartbeat_path="/ckpts/run7/heartbeat.json"))       # orchestrator probe
+
+batches = rz.GuardedIterator(                            # validate every batch
+    make_batches(), spec=rz.spec_of(exemplar_batch),
+    skip_budget=8, stall_timeout_s=120.0)
+
+def step_fn(state, batch, step):                         # step_fn(state, batch, step)
+    return train_step(state, batch)                      # any jitted update
+
+try:
+    state, start = mgr.restore(like=state)               # restart-safe entry
+    start += 1
+except rz.CheckpointError:
+    start = 0
+try:
+    state, last = sup.run(step_fn, state, batches,
+                          num_steps=num_steps, start_step=start)
+except rz.TrainingAborted as abort:                      # resumable by design
+    orchestrator_requeue(resume_from=abort.checkpoint_path)
+```
+
+A slow-but-finished step keeps its result and counts one failure; a hung
+step is reported mid-stall by the watchdog's monitor thread (structured
+`watchdog_stall` event + `stalled` heartbeat marker) so the orchestrator
+can kill and requeue with evidence.  Every path above is driven
+deterministically in tier-1 by the fault injectors (`SlowStep`,
+`FlakyIterator`, `CorruptBatch`).
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -25,6 +25,28 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from apex_tpu._logging import get_logger
+
+logger = get_logger("parallel.distributed")
+
+
+def _bound_axis_size(axis_name: str, what: str) -> int:
+    """Static size of a *bound* named axis, with a diagnosable failure.
+
+    ``jax.lax.psum(1, axis)`` outside shard_map/pmap raises a raw
+    ``NameError: unbound axis name`` that points at JAX internals, not at
+    the actual mistake (calling a collective helper from unmapped code,
+    or over a mesh that was never initialized).  Re-raise it as a
+    RuntimeError that names the axis and the fix.
+    """
+    try:
+        return jax.lax.psum(1, axis_name)
+    except NameError as e:
+        raise RuntimeError(
+            f"{what}: axis {axis_name!r} is not bound — call this inside "
+            f"shard_map/pmap over a mesh that has that axis (e.g. the "
+            f"mesh from parallel_state.initialize_model_parallel)") from e
+
 
 def allreduce_grads(
     grads: Any,
@@ -69,7 +91,21 @@ def broadcast_params(params: Any, axis_name: str = "dp", root: int = 0) -> Any:
 
     Under pjit with replicated sharding this is a no-op by construction; under
     shard_map it selects root's copy via an index-0 all-gather.
+
+    ``root`` is validated eagerly against the (static) axis size: an
+    out-of-range root would mask out EVERY rank and silently broadcast
+    zeros — exactly the corruption a resync pass exists to repair.
     """
+    axis_size = _bound_axis_size(axis_name, "broadcast_params")
+    if not 0 <= root < axis_size:
+        raise ValueError(
+            f"broadcast_params: root {root} is outside axis {axis_name!r} "
+            f"of size {axis_size} (an out-of-range root would broadcast "
+            f"zeros, not any rank's params)")
+    # trace-time breadcrumb (one line per compiled broadcast, not per step)
+    logger.debug("broadcast_params over axis=%s size=%d root=%d",
+                 axis_name, axis_size, root)
+
     def bcast(p):
         # psum of the root-masked value: O(|p|) memory, unlike an all_gather
         # (which would hold world_size copies just to index one out)
@@ -156,8 +192,13 @@ class Reducer:
 
     def reduce(self, tree: Any) -> Any:
         """Mean-reduce every leaf across the axis (the reference Reducer's
-        allreduce-then-divide, as one psum inside shard_map/pmap)."""
-        size = jax.lax.psum(1, self.axis_name)
+        allreduce-then-divide, as one psum inside shard_map/pmap).
+
+        Raises ``RuntimeError`` (not a raw JAX ``NameError``) when called
+        outside a mapped context binding ``axis_name`` — e.g. before the
+        mesh exists, or from plain unmapped code.
+        """
+        size = _bound_axis_size(self.axis_name, "Reducer.reduce")
         return jax.tree.map(
             lambda x: jax.lax.psum(x, self.axis_name) / jnp.asarray(size, x.dtype), tree
         )

@@ -32,6 +32,16 @@ kill, corrupt, restart, converge — a tested code path:
 - :mod:`.data_guard` — validating iterator wrapper (tree/shape/dtype/
   finiteness against a batch spec) with a bounded corrupt-batch skip
   budget and a producer stall timeout.
+- :mod:`.elastic` — *sharded* checkpoints (manifest v2: one CRC'd shard
+  record per (leaf, mesh-coordinate block)) whose restore reassembles
+  each global leaf and re-shards it onto the template's mesh — save on
+  ``(dp=4, tp=2)``, resume bit-identically on ``(dp=2, tp=4)`` or
+  ``dp=8`` (the elastic-restart contract).
+- :mod:`.consistency` — cross-replica desync detection and repair:
+  per-replica leaf hashes inside ``shard_map`` (only u32 digests cross
+  the wire), structured localization of diverged leaves, resync by
+  re-broadcast from rank 0, and the :class:`ReplicaConsistency` policy
+  the supervisor runs every ``consistency_check_interval`` steps.
 
 End-to-end recipe (the shape tier-1's preemption/corruption test runs)::
 
@@ -70,6 +80,17 @@ from apex_tpu.resilience.checkpoint import (
     save_checkpoint,
     validate_checkpoint,
 )
+from apex_tpu.resilience.consistency import (
+    DivergedLeaf,
+    ReplicaConsistency,
+    ReplicaDesyncError,
+    collapse_replicas,
+    expand_replicas,
+    majority_root,
+    replica_hashes,
+    resync_replicas,
+    verify_replicas,
+)
 from apex_tpu.resilience.data_guard import (
     DataStallError,
     GuardedIterator,
@@ -77,8 +98,16 @@ from apex_tpu.resilience.data_guard import (
     spec_of,
     validate_batch,
 )
+from apex_tpu.resilience.elastic import (
+    ShardedCheckpointManager,
+    restore_sharded_checkpoint,
+    save_sharded_checkpoint,
+    validate_sharded_checkpoint,
+)
 from apex_tpu.resilience.fault_injection import (
     CorruptBatch,
+    CorruptShardFile,
+    DesyncReplica,
     FaultInjector,
     FaultPlan,
     FlakyIterator,
@@ -119,11 +148,26 @@ __all__ = [
     "save_checkpoint",
     "validate_checkpoint",
     "CorruptBatch",
+    "CorruptShardFile",
+    "DesyncReplica",
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
     "SimulatedPreemption",
     "SlowStep",
+    "DivergedLeaf",
+    "ReplicaConsistency",
+    "ReplicaDesyncError",
+    "collapse_replicas",
+    "expand_replicas",
+    "majority_root",
+    "replica_hashes",
+    "resync_replicas",
+    "verify_replicas",
+    "ShardedCheckpointManager",
+    "restore_sharded_checkpoint",
+    "save_sharded_checkpoint",
+    "validate_sharded_checkpoint",
     "GuardConfig",
     "GuardState",
     "guarded_update",

@@ -65,7 +65,9 @@ __all__ = [
 
 logger = get_logger("resilience.checkpoint")
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 1            # whole-tree manifests (this module)
+_SHARDED_FORMAT_VERSION = 2    # per-shard manifests (resilience.elastic)
+_KNOWN_VERSIONS = (_FORMAT_VERSION, _SHARDED_FORMAT_VERSION)
 _STEP_PREFIX = "step_"
 _TMP_PREFIX = "tmp_"
 _MANIFEST = "manifest.json"
@@ -114,6 +116,85 @@ def _fsync_dir(path: str) -> None:
         os.close(fd)
 
 
+def _mesh_metadata(axis_sizes: Optional[dict] = None) -> Optional[dict]:
+    """Mesh shape + world sizes as manifest ``mesh`` metadata — the ONE
+    schema both formats stamp (v1 whole-tree and v2 sharded manifests),
+    so the mismatched-mesh guard and elastic resharding read the same
+    fields.  ``axis_sizes`` (``{axis: size}``) keys the record; when
+    omitted it is read from the installed parallel_state mesh, and None
+    is returned outside model-parallel runs."""
+    if axis_sizes is None:
+        try:
+            from apex_tpu.transformer import parallel_state
+
+            axis_sizes = parallel_state.mesh_axis_sizes()
+        except Exception as e:  # stamping is metadata, never save-fatal
+            logger.debug("mesh metadata unavailable: %s: %s",
+                         type(e).__name__, e)
+            return None
+        if axis_sizes is None:
+            return None
+    world = 1
+    for n in axis_sizes.values():
+        world *= n
+    return {"axes": axis_sizes, "axis_names": list(axis_sizes),
+            "world": world, "dp": axis_sizes.get("dp", 1),
+            "tp": axis_sizes.get("tp", 1), "pp": axis_sizes.get("pp", 1)}
+
+
+def _sweep_tmp_dirs(root: str) -> None:
+    """Reclaim ``tmp_*`` dirs orphaned by a hard kill mid-save.  Assumes
+    the single-writer root contract: any tmp dir present at save time is
+    dead weight rotation would never see."""
+    for name in os.listdir(root):
+        if name.startswith(_TMP_PREFIX):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def _commit_step_dir(root: str, tmp_dir: str, final_dir: str) -> None:
+    """Atomically install ``tmp_dir`` as ``final_dir``.
+
+    Re-save of an existing step moves the old dir ASIDE (rename) rather
+    than rmtree-ing it before the new one lands — a kill between the two
+    renames loses at most the microsecond swap window instead of the
+    whole serialization time; the aside copy is deleted only after the
+    new checkpoint is in place, and restored if the install fails.
+    """
+    aside = None
+    if os.path.exists(final_dir):
+        aside = tmp_dir + ".old"
+        os.rename(final_dir, aside)
+    try:
+        os.replace(tmp_dir, final_dir)
+    except BaseException:
+        if aside is not None and not os.path.exists(final_dir):
+            os.rename(aside, final_dir)  # put the old checkpoint back
+        raise
+    _fsync_dir(root)
+    if aside is not None:
+        shutil.rmtree(aside, ignore_errors=True)
+
+
+def _rotate(root: str, keep: int, protect_step: int) -> None:
+    """Keep-last-``keep`` rotation, strictly after the new checkpoint is
+    durable.  Two rules keep it from ever shrinking the recoverable set:
+    ``protect_step`` (the just-written step) is never deleted — even when
+    an undetected-corrupt newer dir occupies the keep window — and
+    checkpoints that fail the cheap structural check (unreadable
+    manifest / truncated payload) are dropped first rather than counted
+    toward ``keep``."""
+    if keep <= 0:
+        return
+    steps = _list_steps(root)
+    sound = [s for s in steps
+             if _quick_valid(os.path.join(root, _step_dirname(s)))]
+    retain = set(sound[-keep:]) | {int(protect_step)}
+    for old in steps:
+        if old not in retain:
+            shutil.rmtree(os.path.join(root, _step_dirname(old)),
+                          ignore_errors=True)
+
+
 def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
     """Write ``tree`` as the step-``step`` checkpoint; returns its path.
 
@@ -130,11 +211,7 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
     """
     t0 = time.monotonic()
     os.makedirs(root, exist_ok=True)
-    # sweep tmp dirs orphaned by a hard kill mid-save (single-writer root:
-    # any tmp_* present now is dead weight that rotation would never see)
-    for name in os.listdir(root):
-        if name.startswith(_TMP_PREFIX):
-            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+    _sweep_tmp_dirs(root)
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
     # ONE batched transfer for the whole tree, not a blocking device_get
     # round-trip per leaf (typed PRNG keys unwrapped to raw key data)
@@ -144,7 +221,6 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
 
     final_dir = os.path.join(root, _step_dirname(step))
     tmp_dir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
-    aside = None
     try:
         # stream leaves straight to disk (no second in-RAM bytes copy of
         # a potentially multi-GB state), recording offsets/CRCs as we go
@@ -172,45 +248,19 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
             "format_version": _FORMAT_VERSION,
             "step": int(step),
             "data_nbytes": offset,
+            "mesh": _mesh_metadata(),
             "leaves": records,
         }
         with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
             json.dump(manifest, f, indent=1)
             f.flush()
             os.fsync(f.fileno())
-        # Re-save of an existing step: move the old dir ASIDE (rename)
-        # rather than rmtree-ing it before the new one lands — a kill
-        # between the two renames loses at most the microsecond swap
-        # window instead of the whole serialization time, and the aside
-        # copy is only deleted after the new checkpoint is in place.
-        if os.path.exists(final_dir):
-            aside = tmp_dir + ".old"
-            os.rename(final_dir, aside)
-        os.replace(tmp_dir, final_dir)
-        _fsync_dir(root)
-        if aside is not None:
-            shutil.rmtree(aside, ignore_errors=True)
+        _commit_step_dir(root, tmp_dir, final_dir)
     except BaseException:
         shutil.rmtree(tmp_dir, ignore_errors=True)
-        if aside is not None and not os.path.exists(final_dir):
-            os.rename(aside, final_dir)  # put the old checkpoint back
         raise
 
-    # Rotation strictly after the new checkpoint is durable.  Two rules
-    # keep it from ever shrinking the recoverable set: the just-written
-    # step is never deleted (even when an undetected-corrupt newer dir
-    # occupies the keep window), and checkpoints that fail the cheap
-    # structural check (unreadable manifest / truncated payload) are
-    # dropped first rather than counted toward ``keep``.
-    if keep > 0:
-        steps = _list_steps(root)
-        sound = [s for s in steps
-                 if _quick_valid(os.path.join(root, _step_dirname(s)))]
-        retain = set(sound[-keep:]) | {int(step)}
-        for old in steps:
-            if old not in retain:
-                shutil.rmtree(os.path.join(root, _step_dirname(old)),
-                              ignore_errors=True)
+    _rotate(root, keep, protect_step=int(step))
     emit_event("checkpoint_saved", step=int(step), bytes=offset,
                path=final_dir, t0=t0)
     return final_dir
@@ -245,10 +295,10 @@ def _read_manifest(ckpt_dir: str) -> dict:
         raise CheckpointError(
             f"{ckpt_dir}: manifest step {manifest.get('step')!r} "
             f"is not an integer")
-    if manifest.get("format_version") != _FORMAT_VERSION:
+    if manifest.get("format_version") not in _KNOWN_VERSIONS:
         raise CheckpointError(
             f"{ckpt_dir}: format_version {manifest.get('format_version')} "
-            f"!= {_FORMAT_VERSION}")
+            f"not in {_KNOWN_VERSIONS}")
     try:
         actual = os.path.getsize(data_path)
     except OSError as e:
@@ -314,6 +364,14 @@ def validate_checkpoint(ckpt_dir: str) -> None:
     or any per-leaf CRC mismatch (bit corruption).
     """
     manifest = _read_manifest(ckpt_dir)
+    if manifest.get("format_version") == _SHARDED_FORMAT_VERSION:
+        # v2 (sharded) dirs validate shard-by-shard; dispatching here
+        # keeps latest_valid_step / rotation / the supervisor's
+        # emergency-checkpoint validation working over mixed roots
+        from apex_tpu.resilience.elastic import _validate_shards
+
+        _validate_shards(ckpt_dir, manifest)
+        return
     with open(os.path.join(ckpt_dir, _DATA), "rb") as f:
         for rec in manifest["leaves"]:
             _read_record(f, rec, ckpt_dir)
@@ -327,6 +385,26 @@ def _load_validated(ckpt_dir: str, like: Any) -> tuple[Any, int]:
     CRC having passed, and restore never re-reads a multi-GB data.bin
     just to prove it good first."""
     manifest = _read_manifest(ckpt_dir)
+    if manifest.get("format_version") == _SHARDED_FORMAT_VERSION:
+        raise CheckpointError(
+            f"{ckpt_dir}: sharded (format v2) checkpoint — restore it "
+            f"through apex_tpu.resilience.elastic.restore_sharded_checkpoint"
+            f", which reassembles shard records and reshards onto the "
+            f"current mesh")
+    saved_mesh = manifest.get("mesh")
+    cur_mesh = _mesh_metadata()
+    if (isinstance(saved_mesh, dict) and cur_mesh is not None
+            and saved_mesh.get("axes") != cur_mesh["axes"]):
+        # a v1 checkpoint is one whole-tree byte stream with no shard
+        # records: restoring it onto a different mesh shape would hand
+        # every template leaf the OLD global bytes and silently reshard
+        # them wrong.  Elastic restarts need the v2 sharded format.
+        raise CheckpointError(
+            f"{ckpt_dir}: whole-tree (v1) checkpoint was saved on mesh "
+            f"{saved_mesh.get('axes')} but the current mesh is "
+            f"{cur_mesh['axes']} — v1 checkpoints cannot reshard; save "
+            f"sharded checkpoints (resilience.elastic) to resume on a "
+            f"different mesh shape")
     by_path = {r.get("path"): r for r in manifest["leaves"]
                if isinstance(r, dict)}
 

@@ -1,0 +1,492 @@
+"""Cross-replica consistency: detect, localize, and repair dp desync.
+
+Data-parallel replicas are supposed to hold bit-identical state — the
+gradient all-reduce hands every replica the same update.  At pod scale
+that invariant silently breaks anyway: a bit flip in one replica's HBM,
+a diverged host applying a stale update, a collective that dropped a
+participant (PAPERS.md TPU-pod papers treat silent replica divergence as
+a first-class fault).  An unnoticed desync is the *worst* failure mode:
+every subsequent all-reduce averages the corruption into the whole pod.
+
+This module makes the invariant checkable and repairable:
+
+- **Representation.**  Per-replica state is *stacked*: each leaf carries
+  a leading replica axis sharded over ``dp`` — shape ``(dp, ...)`` with
+  spec ``P('dp', ...)`` — so replica copies are distinct buffers a fault
+  can actually diverge (a logically-replicated array has one buffer and
+  cannot).  ``expand_replicas`` / ``collapse_replicas`` convert between
+  this and the logical single-copy form (which is what elastic sharded
+  checkpoints persist — the stacked form's global shape depends on the
+  mesh, the logical form does not).
+- :func:`verify_replicas` hashes every leaf per dp-replica *inside*
+  ``shard_map`` — only one u32 hash and one f32 delta per (leaf,
+  replica) cross the wire, never the parameters — and localizes each
+  diverged leaf (keystr path, diverged ranks, max-abs delta vs rank 0)
+  through structured ``replica_desync`` events.
+- :func:`resync_replicas` repairs in place by re-broadcasting rank 0's
+  copy, reusing :func:`apex_tpu.parallel.distributed.broadcast_params`
+  under ``shard_map`` over the replica axis.
+- :class:`ReplicaConsistency` is the policy object
+  :class:`~apex_tpu.resilience.supervisor.TrainingSupervisor` runs every
+  ``consistency_check_interval`` steps: verify → resync → re-verify,
+  raising :class:`ReplicaDesyncError` (one unrecovered failure in the
+  supervisor's escalation ladder) only when the repair itself fails or
+  resync is disabled.
+
+Scope: pass the subtree that *should* be replica-identical (params,
+optimizer state).  Leaves whose spec does not mention the replica axis
+are logically shared and skipped; dp-*sharded data* (e.g. ZeRO-style
+optimizer shards) is not replicated and must not be passed here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import inspect
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.6 exports it at top level (and renames check_rep)
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# replication checking is named check_vma (new) / check_rep (0.4.x);
+# disable it either way — the hash pass mixes per-leaf specs and its
+# outputs are made replicated by explicit psum/all_gather, which older
+# rep-checkers reject conservatively
+_SHARD_MAP_KW = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else {"check_rep": False})
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.parallel.distributed import broadcast_params
+from apex_tpu.utils.serialization import is_prng_key
+
+__all__ = [
+    "DivergedLeaf",
+    "ReplicaConsistency",
+    "ReplicaDesyncError",
+    "collapse_replicas",
+    "expand_replicas",
+    "majority_root",
+    "replica_hashes",
+    "resync_replicas",
+    "verify_replicas",
+]
+
+logger = get_logger("resilience.consistency")
+
+
+class ReplicaDesyncError(RuntimeError):
+    """Replicas diverged and could not (or may not) be resynced.
+
+    Carries ``step`` and ``report`` (the :class:`DivergedLeaf` list).
+    Deterministic by definition — re-running the hash pass re-proves the
+    same divergence — so the retry layer must never retry it.
+    """
+
+    transient = False
+
+    def __init__(self, step: int, report: Sequence["DivergedLeaf"]):
+        names = ", ".join(f"{d.path} (ranks {list(d.ranks)})"
+                          for d in report) or "<none>"
+        super().__init__(
+            f"replica desync at step {step}: {len(report)} diverged "
+            f"leaves: {names}")
+        self.step = int(step)
+        self.report = list(report)
+
+
+@dataclasses.dataclass(frozen=True)
+class DivergedLeaf:
+    """One localized divergence: which leaf, which replicas, how far."""
+
+    path: str
+    ranks: tuple  # dp ranks whose hash differs from rank 0's
+    max_abs_delta: float  # max |replica - rank0| over the diverged ranks
+    hashes: tuple  # per-rank u32 leaf hashes (diagnostic)
+
+
+def _infer_mesh(tree: Any, mesh: Optional[Mesh] = None, *,
+                required: bool = True) -> Optional[Mesh]:
+    """The mesh a pass runs over: an explicit ``mesh`` wins, else the
+    first NamedSharding in the tree, else the installed parallel_state
+    mesh.  With ``required=False`` (the elastic-save caller) a missing
+    mesh returns None — every leaf then saves as one replicated shard —
+    instead of raising."""
+    if mesh is not None:
+        return mesh
+    for leaf in jax.tree.leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return sharding.mesh
+    from apex_tpu.transformer import parallel_state
+
+    if parallel_state.model_parallel_is_initialized():
+        return parallel_state.get_mesh()
+    if required:
+        raise ValueError(
+            "no mesh: pass mesh=, or put leaves with NamedSharding, or "
+            "initialize parallel_state first")
+    return None
+
+
+def _entry_names(entry) -> tuple:
+    """ONE PartitionSpec entry as a tuple of axis names — ``None`` →
+    ``()``, ``'dp'`` → ``('dp',)``, ``('dp', 'tp')`` unchanged.  The
+    single normalization every replica-stacked classification
+    (verify/resync, collapse, fault injection, shard grids) shares, so
+    they cannot drift on str-vs-tuple spec forms."""
+    if entry is None:
+        return ()
+    if isinstance(entry, str):
+        return (entry,)
+    return tuple(entry)
+
+
+def _full_spec(leaf: Any) -> P:
+    """The leaf's PartitionSpec padded to full rank (shard_map wants
+    exact-rank specs; trailing unmentioned dims are replicated)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = sharding.spec if isinstance(sharding, NamedSharding) else P()
+    ndim = np.ndim(leaf)
+    entries = [spec[d] if d < len(spec) else None for d in range(ndim)]
+    return P(*entries)
+
+
+def _participates(spec: P, axis_name: str) -> bool:
+    return any(axis_name in _entry_names(entry) for entry in spec)
+
+
+def _shard_hash(x):
+    """Order-sensitive u32 checksum of a local shard's raw bytes.
+
+    Bytes are packed into u32 WORDS (zero-padded tail) and positionally
+    weighted: ``sum(word[i] * (i + 1)) mod 2**32``.  Any single flipped
+    byte changes its word and therefore the sum, and two equal
+    populations in different orders hash differently — cheap, jit-safe,
+    and only the 4-byte digest ever leaves the device.  Packing keeps
+    the transient working set at ~2x the shard's bytes (words +
+    weights); a per-BYTE u32 expansion would be ~8x, a real HBM spike on
+    the pod-scale leaves this pass exists for.
+    """
+    if x.size == 0:
+        return jnp.zeros((), jnp.uint32)
+    if x.dtype == jnp.bool_:
+        x = x.astype(jnp.uint8)
+    b = jax.lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+    pad = (-b.size) % 4
+    if pad:
+        b = jnp.concatenate([b, jnp.zeros((pad,), jnp.uint8)])
+    words = jax.lax.bitcast_convert_type(b.reshape(-1, 4), jnp.uint32)
+    weights = jnp.arange(words.size, dtype=jnp.uint32) + jnp.uint32(1)
+    return jnp.sum(words * weights, dtype=jnp.uint32)
+
+
+def _select(tree: Any, axis_name: str):
+    """Flatten ``tree`` into (paths, leaves, specs, participating mask),
+    unwrapping typed PRNG keys to raw key data so byte hashing and the
+    psum broadcast stay dtype-legal."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths, leaves, specs, part = [], [], [], []
+    for path, leaf in flat:
+        spec = _full_spec(leaf)
+        if is_prng_key(leaf):
+            leaf = jax.random.key_data(leaf)
+            # key_data adds trailing dims; pad the spec back to full rank
+            entries = list(spec) + [None] * (np.ndim(leaf) - len(spec))
+            spec = P(*entries)
+        paths.append(jax.tree_util.keystr(path))
+        leaves.append(leaf)
+        specs.append(spec)
+        part.append(_participates(spec, axis_name))
+    return treedef, flat, paths, leaves, specs, part
+
+
+def replica_hashes(tree: Any, *, mesh: Optional[Mesh] = None,
+                   axis_name: str = "dp") -> dict:
+    """Per-replica hashes and max-abs deltas for every replica-stacked
+    leaf: ``{keystr: {"hashes": (dp,) u32, "max_abs_delta": (dp,) f32}}``.
+
+    Computed inside one ``shard_map`` over the full mesh: each leaf's
+    local-shard hash is summed over the non-replica axes (combining a
+    replica's tp/pp shards into one digest) and all-gathered over the
+    replica axis; the delta is each replica's max ``|x - x_rank0|``
+    (values cast to f32 — a diagnostic magnitude, not a comparison; the
+    byte hash is the equality oracle).
+    """
+    mesh = _infer_mesh(tree, mesh)
+    if axis_name not in mesh.axis_names:
+        raise ValueError(f"mesh has no axis {axis_name!r} "
+                         f"(axes: {mesh.axis_names})")
+    _, _, paths, leaves, specs, part = _select(tree, axis_name)
+    sel = [i for i, p in enumerate(part) if p]
+    if not sel:
+        return {}
+    sel_leaves = tuple(leaves[i] for i in sel)
+    sel_specs = tuple(specs[i] for i in sel)
+    hashes, deltas = _hash_pass(mesh, axis_name, sel_specs)(sel_leaves)
+    return {paths[i]: {"hashes": np.asarray(h), "max_abs_delta": np.asarray(d)}
+            for i, h, d in zip(sel, hashes, deltas)}
+
+
+@functools.lru_cache(maxsize=64)
+def _hash_pass(mesh: Mesh, axis_name: str, specs: tuple):
+    """The compiled hash computation for one (mesh, replica axis, spec
+    tuple).  Cached — a fresh closure per call would defeat jax's trace
+    cache and retrace/recompile the whole pass on EVERY periodic
+    supervisor check."""
+    other_axes = tuple(a for a in mesh.axis_names if a != axis_name)
+
+    def hash_all(xs):
+        hashes, deltas = [], []
+        rank = jax.lax.axis_index(axis_name)
+        for x in xs:
+            h = _shard_hash(x)
+            if other_axes:
+                h = jax.lax.psum(h, other_axes)
+            hashes.append(jax.lax.all_gather(h, axis_name))
+            xv = x.astype(jnp.float32)
+            x0 = jax.lax.psum(
+                jnp.where(rank == 0, xv, jnp.zeros_like(xv)), axis_name)
+            d = (jnp.max(jnp.abs(xv - x0)) if x.size
+                 else jnp.zeros((), jnp.float32))
+            if other_axes:
+                d = jax.lax.pmax(d, other_axes)
+            deltas.append(jax.lax.all_gather(d, axis_name))
+        return tuple(hashes), tuple(deltas)
+
+    return jax.jit(_shard_map(hash_all, mesh=mesh, in_specs=(specs,),
+                              out_specs=P(), **_SHARD_MAP_KW))
+
+
+def verify_replicas(tree: Any, *, mesh: Optional[Mesh] = None,
+                    axis_name: str = "dp", step: int = 0,
+                    emit: bool = True) -> list:
+    """Prove dp replicas bit-identical; localize every divergence.
+
+    Returns a (possibly empty) list of :class:`DivergedLeaf`, one per
+    leaf whose per-replica hashes disagree with rank 0's, and (when
+    ``emit``) a structured ``replica_desync`` event per diverged leaf —
+    name, ranks, max-abs delta — so a fleet collector can alert on the
+    exact parameter, not just "a replica is off".
+
+    ``ranks`` is *relative to rank 0*: when the fault landed on rank 0
+    itself, every OTHER rank is reported diverged.  The per-rank
+    ``hashes`` carry the evidence either way — majority analysis (see
+    :func:`majority_root`) identifies the actual outlier.
+    """
+    t0 = time.monotonic()
+    report = []
+    for path, rec in replica_hashes(tree, mesh=mesh,
+                                    axis_name=axis_name).items():
+        hashes = rec["hashes"]
+        bad = tuple(int(r) for r in range(len(hashes))
+                    if int(hashes[r]) != int(hashes[0]))
+        if not bad:
+            continue
+        max_delta = float(np.max(rec["max_abs_delta"][list(bad)]))
+        diverged = DivergedLeaf(path=path, ranks=bad,
+                                max_abs_delta=max_delta,
+                                hashes=tuple(int(h) for h in hashes))
+        report.append(diverged)
+        if emit:
+            emit_event("replica_desync", leaf=path, step=int(step),
+                       ranks=list(bad), max_abs_delta=max_delta,
+                       replicas=int(len(hashes)))
+    if emit and report:
+        emit_event("replica_verify_failed", step=int(step),
+                   diverged_leaves=[d.path for d in report], t0=t0)
+    return report
+
+
+@functools.lru_cache(maxsize=64)
+def _resync_pass(mesh: Mesh, axis_name: str, root: int, specs: tuple):
+    """Compiled re-broadcast for one (mesh, axis, root, spec tuple) —
+    cached for the same retrace reason as :func:`_hash_pass`."""
+    return jax.jit(_shard_map(
+        lambda xs: tuple(broadcast_params(x, axis_name, root) for x in xs),
+        mesh=mesh, in_specs=(specs,), out_specs=specs,
+        **_SHARD_MAP_KW))
+
+
+def resync_replicas(tree: Any, *, mesh: Optional[Mesh] = None,
+                    axis_name: str = "dp", root: int = 0) -> Any:
+    """Repair a desync: every replica adopts rank ``root``'s copy.
+
+    Re-broadcasts each replica-stacked leaf from ``root`` with
+    :func:`apex_tpu.parallel.distributed.broadcast_params` under
+    ``shard_map`` over the replica axis (a masked psum — O(leaf) memory,
+    bit-exact for the surviving copy).  Leaves that do not carry the
+    replica axis pass through untouched; typed PRNG keys round-trip
+    through their raw key data.
+    """
+    mesh = _infer_mesh(tree, mesh)
+    treedef, flat, paths, leaves, specs, part = _select(tree, axis_name)
+    sel = [i for i, p in enumerate(part) if p]
+    if not sel:
+        return tree
+    sel_leaves = tuple(leaves[i] for i in sel)
+    sel_specs = tuple(specs[i] for i in sel)
+
+    synced = _resync_pass(mesh, axis_name, int(root),
+                          sel_specs)(sel_leaves)
+
+    out_leaves = []
+    for i, (path, orig) in enumerate(flat):
+        if i not in sel:
+            out_leaves.append(orig)
+            continue
+        fixed = synced[sel.index(i)]
+        if is_prng_key(orig):
+            fixed = jax.random.wrap_key_data(
+                fixed, impl=jax.random.key_impl(orig))
+            sharding = getattr(orig, "sharding", None)
+            if sharding is not None:
+                fixed = jax.device_put(fixed, sharding)
+        out_leaves.append(fixed)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+def majority_root(report: Sequence[DivergedLeaf], *,
+                  default: int = 0) -> int:
+    """The safest broadcast source for a repair: a replica whose hash
+    agrees with the strict per-leaf MAJORITY for every diverged leaf.
+
+    Always resyncing from rank 0 propagates the corruption when the
+    fault landed on rank 0 itself (every other rank then reads as
+    "diverged", but the majority is right and rank 0 is the outlier).
+    Falls back to ``default`` when no rank is majority-consistent across
+    all diverged leaves — e.g. a 50/50 split at dp=2, where the hashes
+    alone cannot say who is right.
+    """
+    candidates: Optional[set] = None
+    for d in report:
+        counts: dict = {}
+        for h in d.hashes:
+            counts[h] = counts.get(h, 0) + 1
+        best = max(counts.values())
+        maj = (set() if best * 2 <= len(d.hashes)
+               else {r for r, h in enumerate(d.hashes)
+                     if counts[h] == best})
+        candidates = maj if candidates is None else candidates & maj
+    return min(candidates) if candidates else int(default)
+
+
+# --------------------------------------------------------------------------
+# stacked <-> logical conversion (what elastic checkpoints persist)
+# --------------------------------------------------------------------------
+
+
+def collapse_replicas(tree: Any, *, axis_name: str = "dp") -> Any:
+    """Stacked per-replica state -> ONE logical copy (rank 0's).
+
+    Drops the leading replica axis of every leaf whose spec starts with
+    ``axis_name`` (other leaves pass through).  The result's global
+    shapes no longer depend on the dp world size — the form
+    :mod:`apex_tpu.resilience.elastic` persists, so a different-dp
+    restart can re-expand.  Verify replicas first: collapsing a
+    desynced state silently blesses rank 0's copy.
+    """
+    def collapse(leaf):
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            return leaf
+        spec = _full_spec(leaf)
+        lead = spec[0] if len(spec) else None
+        # 'dp' and ('dp',) are the same sharding: the collapse must
+        # agree with what verify/resync classify as stacked
+        if _entry_names(lead) != (axis_name,):
+            return leaf
+        logical = leaf[0]
+        return jax.device_put(
+            logical, NamedSharding(sharding.mesh, P(*spec[1:])))
+
+    return jax.tree.map(collapse, tree)
+
+
+def expand_replicas(tree: Any, mesh: Mesh, *,
+                    axis_name: str = "dp") -> Any:
+    """ONE logical copy -> stacked per-replica state on ``mesh``.
+
+    Broadcasts every leaf along a new leading replica axis of size
+    ``mesh.shape[axis_name]`` and shards it ``P(axis_name, *leaf_spec)``
+    — the inverse of :func:`collapse_replicas`, used after an elastic
+    restore to rebuild the per-replica representation at the NEW dp
+    world size.  Pass the subtree that should be per-replica (the same
+    one you collapse).
+    """
+    n = int(mesh.shape[axis_name])
+
+    def expand(leaf):
+        spec = _full_spec(leaf)
+        stacked = jnp.broadcast_to(
+            jnp.asarray(leaf)[None], (n,) + tuple(np.shape(leaf)))
+        return jax.device_put(
+            stacked, NamedSharding(mesh, P(axis_name, *spec)))
+
+    return jax.tree.map(expand, tree)
+
+
+# --------------------------------------------------------------------------
+# the supervisor's policy object
+# --------------------------------------------------------------------------
+
+
+class ReplicaConsistency:
+    """verify -> resync -> re-verify, as one supervisor-pluggable pass.
+
+    ``check(state, step)`` returns the (possibly repaired) state.  On
+    divergence it resyncs from the :func:`majority_root` — the replica
+    the per-leaf hash majority says is intact, so a fault on rank 0
+    itself is repaired FROM the majority instead of broadcast to it —
+    falling back to ``root`` when the hashes cannot elect one (a 50/50
+    split), then re-verifies.  It raises :class:`ReplicaDesyncError`
+    only when ``resync`` is disabled or the repair itself fails to
+    converge — which the supervisor counts as an unrecovered failure and
+    escalates through the retry → emergency-checkpoint → abort ladder.
+
+    >>> sup = TrainingSupervisor(
+    ...     mgr, SupervisorConfig(consistency_check_interval=50),
+    ...     consistency=ReplicaConsistency(mesh=mesh))
+    """
+
+    def __init__(self, mesh: Optional[Mesh] = None, *,
+                 axis_name: str = "dp", resync: bool = True,
+                 root: int = 0):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.resync = resync
+        self.root = root
+        self.resyncs = 0  # lifetime repair count (observability)
+
+    def check(self, tree: Any, *, step: int = 0) -> Any:
+        report = verify_replicas(tree, mesh=self.mesh,
+                                 axis_name=self.axis_name, step=step)
+        if not report:
+            return tree
+        if not self.resync:
+            raise ReplicaDesyncError(step, report)
+        t0 = time.monotonic()
+        root = majority_root(report, default=self.root)
+        repaired = resync_replicas(tree, mesh=self.mesh,
+                                   axis_name=self.axis_name,
+                                   root=root)
+        still_bad = verify_replicas(repaired, mesh=self.mesh,
+                                    axis_name=self.axis_name, step=step,
+                                    emit=False)
+        if still_bad:
+            raise ReplicaDesyncError(step, still_bad)
+        self.resyncs += 1
+        emit_event("replica_resync", step=int(step), root=root,
+                   leaves=[d.path for d in report],
+                   resyncs=self.resyncs, t0=t0)
+        return repaired

@@ -1,0 +1,541 @@
+"""Elastic sharded checkpoints: save per-shard, restore onto ANY mesh.
+
+PR 1's whole-tree checkpoints serialize every global leaf from one host
+and restore assumes the identical mesh — fine on a workstation, wrong at
+pod scale, where a preempted job restarts onto *whatever slice is
+available* (PAPERS.md: "Scale MLPerf-0.6 models on Google TPU-v3 Pods";
+"Exploring the limits of Concurrency in ML Training on Google TPUs").
+This module makes the checkpoint itself mesh-shape-agnostic:
+
+- **Save** writes one *shard record* per (leaf, mesh-coordinate block):
+  the leaf's :class:`~jax.sharding.PartitionSpec` determines the block
+  grid, and each record carries its mesh coordinates, its concrete index
+  (start/stop per dim), and its own CRC32 — so one flipped byte is
+  localized to one shard of one leaf, not "the checkpoint is bad".
+- **Manifest v2** extends the v1 schema: ``format_version: 2``,
+  ``sharded: true``, the saving mesh's shape / axis names / dp-tp-pp
+  world sizes, and per-leaf entries that record the GLOBAL shape, dtype,
+  partition spec, and the shard list.
+- **Restore** reassembles each global leaf from its shard records
+  (seek + read + CRC per shard, placed by the recorded index) and then
+  re-shards it onto the *template's* sharding — which may live on a
+  completely different mesh shape.  Saving on ``(dp=4, tp=2)`` and
+  resuming on ``(dp=2, tp=4)`` or ``dp=8`` is the tested contract
+  (``tests/test_elastic.py``), bit-identical by construction because the
+  bytes never pass through arithmetic.
+
+Everything else — atomic temp-dir + rename commit, orphan sweep,
+keep-last-K rotation that never shrinks the recoverable set, the
+newest-valid fallback walk with ``checkpoint_rejected`` events — is the
+same machinery as :mod:`apex_tpu.resilience.checkpoint`, reused, not
+re-implemented.  A root may mix v1 and v2 directories: the fallback walk
+loads whichever format each candidate carries (a v1 candidate still
+requires a matching mesh; only v2 reshards).
+
+Replica semantics: leaves whose leading axis stacks per-``dp``-replica
+copies (the :mod:`apex_tpu.resilience.consistency` representation) are
+mesh-shape-*dependent* — collapse them to one logical copy with
+:func:`~apex_tpu.resilience.consistency.collapse_replicas` before
+saving, and re-expand after restore.  The docs/index.md "resize the pod
+mid-training" recipe shows the full sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import shutil
+import tempfile
+import time
+import zlib
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.resilience.checkpoint import (
+    _DATA,
+    _MANIFEST,
+    _SHARDED_FORMAT_VERSION,
+    _TMP_PREFIX,
+    CheckpointError,
+    _commit_step_dir,
+    _list_steps,
+    _mesh_metadata,
+    _read_manifest,
+    _rotate,
+    _step_dirname,
+    _sweep_tmp_dirs,
+)
+from apex_tpu.resilience.consistency import _entry_names, _infer_mesh
+from apex_tpu.utils.serialization import (
+    is_prng_key,
+    leaf_from_numpy,
+    leaf_spec,
+    np_dtype,
+)
+
+__all__ = [
+    "ShardedCheckpointManager",
+    "restore_sharded_checkpoint",
+    "save_sharded_checkpoint",
+    "validate_sharded_checkpoint",
+]
+
+logger = get_logger("resilience.elastic")
+
+
+# --------------------------------------------------------------------------
+# partition-spec / shard-grid geometry
+# --------------------------------------------------------------------------
+
+
+def _spec_entries(spec, ndim: int) -> list[tuple[str, ...]]:
+    """Normalize a PartitionSpec to ``ndim`` per-dim tuples of axis names
+    (``()`` = replicated dim).  Accepts None (fully replicated), short
+    specs (trailing dims replicated), str / tuple entries."""
+    return [_entry_names(spec[d] if spec is not None and d < len(spec)
+                         else None)
+            for d in range(ndim)]
+
+
+def _leaf_partition_spec(leaf: Any, override) -> Optional[P]:
+    """The spec a leaf is saved under: an explicit override wins, else
+    the leaf's own NamedSharding spec, else fully replicated."""
+    if override is not None:
+        return override
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return None
+
+
+def _shard_grid(entries: Sequence[tuple[str, ...]], shape: Sequence[int],
+                axis_sizes: dict, what: str):
+    """Yield ``(coords, index)`` for every shard of one leaf.
+
+    ``coords`` maps each partitioning mesh axis to its coordinate;
+    ``index`` is ``[[start, stop], ...]`` per array dim.  Tuple spec
+    entries split a dim major-to-minor in axis order, matching jax's
+    ``NamedSharding`` layout.  Raises :class:`CheckpointError` when a
+    dim is not evenly divisible by its axes' product — uneven (padded)
+    shards have no stable byte layout to reshard from.
+    """
+    axes: list[str] = [a for entry in entries for a in entry]
+    if len(set(axes)) != len(axes):
+        # a repeated axis would collapse in the coords dict and emit
+        # duplicate shard indices — an unrestorable checkpoint that save
+        # must refuse to write, not validation discover later
+        raise CheckpointError(
+            f"{what}: spec uses a mesh axis more than once ({axes})")
+    blocks = []  # per-dim block size
+    for d, entry in enumerate(entries):
+        n = 1
+        for a in entry:
+            if a not in axis_sizes:
+                raise CheckpointError(
+                    f"{what}: spec axis {a!r} is not a mesh axis "
+                    f"(mesh has {sorted(axis_sizes)})")
+            n *= axis_sizes[a]
+        if n and shape[d] % n:
+            raise CheckpointError(
+                f"{what}: dim {d} of size {shape[d]} is not divisible by "
+                f"its partitioning axes {entry} (product {n})")
+        blocks.append(shape[d] // n if n else shape[d])
+    for combo in itertools.product(
+            *[range(axis_sizes[a]) for a in axes]):
+        coords = dict(zip(axes, combo))
+        index = []
+        for d, entry in enumerate(entries):
+            block = 0
+            for a in entry:  # major-to-minor, NamedSharding order
+                block = block * axis_sizes[a] + coords[a]
+            start = block * blocks[d]
+            index.append([start, start + blocks[d]])
+        yield coords, index
+
+
+def _mesh_axis_sizes(mesh: Optional[Mesh]) -> dict:
+    return {} if mesh is None else {name: int(size)
+                                    for name, size in mesh.shape.items()}
+
+
+def _spec_json(entries: Sequence[tuple[str, ...]]) -> list:
+    return [list(e) if e else None for e in entries]
+
+
+# --------------------------------------------------------------------------
+# save
+# --------------------------------------------------------------------------
+
+
+def save_sharded_checkpoint(root: str, step: int, tree: Any, *,
+                            mesh: Optional[Mesh] = None,
+                            specs: Any = None,
+                            keep: int = 3) -> str:
+    """Write ``tree`` as the step-``step`` *sharded* checkpoint.
+
+    Each leaf is cut into the shard grid its PartitionSpec implies on
+    ``mesh`` (leaves' own ``NamedSharding`` specs by default; ``specs``
+    — a matching pytree of PartitionSpecs, or None entries — overrides
+    per leaf) and every shard gets its own manifest record + CRC.  The
+    atomic-commit / orphan-sweep / rotation contract is identical to
+    :func:`~apex_tpu.resilience.checkpoint.save_checkpoint`, including
+    the single-writer root assumption.
+    """
+    t0 = time.monotonic()
+    os.makedirs(root, exist_ok=True)
+    _sweep_tmp_dirs(root)
+    if mesh is None:
+        mesh = _infer_mesh(tree, required=False)
+    axis_sizes = _mesh_axis_sizes(mesh)
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    if specs is not None:
+        spec_leaves = jax.tree.leaves(
+            specs, is_leaf=lambda x: x is None or isinstance(x, P))
+        if len(spec_leaves) != len(flat):
+            raise ValueError(
+                f"specs has {len(spec_leaves)} leaves for a tree of "
+                f"{len(flat)} (pass a matching pytree of PartitionSpecs)")
+    else:
+        spec_leaves = [None] * len(flat)
+    # ONE batched transfer for the whole tree (typed PRNG keys unwrapped)
+    host_leaves = jax.device_get(
+        [jax.random.key_data(l) if is_prng_key(l) else l for _, l in flat])
+    host_leaves = [np.asarray(a) for a in host_leaves]
+
+    final_dir = os.path.join(root, _step_dirname(step))
+    tmp_dir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
+    try:
+        records, offset = [], 0
+        with open(os.path.join(tmp_dir, _DATA), "wb") as f:
+            for (path, leaf), arr, override in zip(flat, host_leaves,
+                                                   spec_leaves):
+                key = jax.tree_util.keystr(path)
+                spec = _leaf_partition_spec(leaf, override)
+                entries = _spec_entries(spec, arr.ndim)
+                shards = []
+                for coords, index in _shard_grid(entries, arr.shape,
+                                                 axis_sizes, key):
+                    block = arr[tuple(slice(lo, hi) for lo, hi in index)]
+                    data = np.ascontiguousarray(block).tobytes()
+                    shards.append({
+                        "coords": coords,
+                        "index": index,
+                        "offset": offset,
+                        "nbytes": len(data),
+                        "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                    })
+                    f.write(data)
+                    offset += len(data)
+                records.append({
+                    "path": key,
+                    "shape": list(arr.shape),  # GLOBAL shape
+                    "dtype": arr.dtype.name,
+                    "prng_key": is_prng_key(leaf),
+                    "spec": _spec_json(entries),
+                    "shards": shards,
+                })
+            f.flush()
+            os.fsync(f.fileno())
+        manifest = {
+            "format_version": _SHARDED_FORMAT_VERSION,
+            "sharded": True,
+            "step": int(step),
+            "data_nbytes": offset,
+            "mesh": _mesh_metadata(axis_sizes or None),
+            "leaves": records,
+        }
+        with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        _commit_step_dir(root, tmp_dir, final_dir)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+
+    _rotate(root, keep, protect_step=int(step))
+    emit_event("checkpoint_saved", step=int(step), bytes=offset,
+               path=final_dir, sharded=True,
+               n_shards=sum(len(r["shards"]) for r in records), t0=t0)
+    return final_dir
+
+
+# --------------------------------------------------------------------------
+# validate / restore
+# --------------------------------------------------------------------------
+
+
+def _read_shard(f, shard: dict, rec: dict, ckpt_dir: str) -> np.ndarray:
+    """Seek/read/CRC-check ONE shard record; the sharded counterpart of
+    checkpoint._read_record, with the same error discipline: defects the
+    bytes can produce come back as :class:`CheckpointError`; an OSError
+    on the open file is host I/O and propagates for the retry layer."""
+    try:
+        offset, nbytes = int(shard["offset"]), int(shard["nbytes"])
+        if offset < 0 or nbytes < 0:
+            raise ValueError(f"negative extent ({offset}, {nbytes})")
+        index = [(int(lo), int(hi)) for lo, hi in shard["index"]]
+        shape = [hi - lo for lo, hi in index]
+        if any(lo < 0 or hi < lo or hi > g
+               for (lo, hi), g in zip(index, rec["shape"])):
+            raise ValueError(f"index {index} outside global "
+                             f"shape {rec['shape']}")
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        if len(chunk) != nbytes:
+            raise ValueError(f"short read ({len(chunk)} of {nbytes} bytes)")
+        arr = np.frombuffer(chunk, dtype=np_dtype(rec["dtype"]))
+        arr = arr.reshape(shape)
+    except CheckpointError:
+        raise
+    except OSError:
+        raise
+    except Exception as e:
+        raise CheckpointError(
+            f"{ckpt_dir}: unusable shard {shard.get('coords')} of leaf "
+            f"{rec.get('path', '?')!r}: {type(e).__name__}: {e}") from e
+    if (zlib.crc32(chunk) & 0xFFFFFFFF) != shard.get("crc32"):
+        raise CheckpointError(
+            f"{ckpt_dir}: CRC mismatch on shard {shard.get('coords')} of "
+            f"leaf {rec.get('path', '?')!r}")
+    return arr
+
+
+def _iter_shard_records(manifest: dict, ckpt_dir: str):
+    for rec in manifest["leaves"]:
+        if not isinstance(rec, dict) or not isinstance(
+                rec.get("shards"), list):
+            raise CheckpointError(
+                f"{ckpt_dir}: leaf record "
+                f"{rec.get('path', '?') if isinstance(rec, dict) else rec!r} "
+                f"has no shard list")
+        yield rec
+
+
+def _check_tiling(rec: dict, ckpt_dir: str) -> None:
+    """Prove one leaf's shard list tiles its GLOBAL shape exactly.
+
+    Per dim, the distinct ``(start, stop)`` intervals must chain
+    ``0..size`` with no gap or overlap, and the shard index set must be
+    precisely their cross product.  Byte totals alone cannot prove this:
+    a damaged-but-parsable manifest with overlapping indices (CRCs
+    intact — they cover the data bytes, not the index semantics) would
+    pass a size check while leaving regions of the reassembled leaf
+    unwritten."""
+    what = f"{ckpt_dir}: leaf {rec.get('path', '?')!r}"
+    try:  # a parsable-but-damaged record must reject, not TypeError —
+        # latest_valid_step / the fallback walk only skip CheckpointError
+        shape = [int(n) for n in rec["shape"]]
+        ndim = len(shape)
+        if 0 in shape:
+            return  # empty leaf: every shard is degenerate, none placed
+        indices = {tuple((int(lo), int(hi)) for lo, hi in s["index"])
+                   for s in rec["shards"]}
+    except Exception as e:
+        raise CheckpointError(
+            f"{what}: unusable shape/shard index list: "
+            f"{type(e).__name__}: {e}") from e
+    if len(indices) != len(rec["shards"]):
+        raise CheckpointError(f"{what}: duplicate shard indices")
+    if any(len(ix) != ndim for ix in indices):
+        raise CheckpointError(f"{what}: shard index rank != leaf rank")
+    n_blocks = 1
+    for d in range(ndim):
+        ivs = sorted({ix[d] for ix in indices})
+        if not (ivs and ivs[0][0] == 0 and ivs[-1][1] == shape[d]
+                and all(a[1] == b[0] for a, b in zip(ivs, ivs[1:]))):
+            raise CheckpointError(
+                f"{what}: dim {d} shard intervals {ivs} do not tile "
+                f"[0, {shape[d]}) (gap or overlap)")
+        n_blocks *= len(ivs)
+    # distinct tuples, each component drawn from its dim's interval set,
+    # matching the grid's cardinality == exactly the cross product
+    if len(indices) != n_blocks:
+        raise CheckpointError(
+            f"{what}: {len(indices)} shards do not fill the "
+            f"{n_blocks}-block grid their per-dim intervals imply")
+
+
+def _validate_shards(ckpt_dir: str, manifest: dict) -> None:
+    """Tiling-check and CRC every shard of every leaf (the v2 body of
+    checkpoint.validate_checkpoint, which dispatches here)."""
+    with open(os.path.join(ckpt_dir, _DATA), "rb") as f:
+        for rec in _iter_shard_records(manifest, ckpt_dir):
+            _check_tiling(rec, ckpt_dir)
+            for shard in rec["shards"]:
+                _read_shard(f, shard, rec, ckpt_dir)
+
+
+def validate_sharded_checkpoint(ckpt_dir: str) -> None:
+    """Prove a sharded checkpoint directory internally consistent:
+    manifest structure, payload size, and every per-shard CRC.  Raises
+    :class:`CheckpointError` on any defect."""
+    manifest = _read_manifest(ckpt_dir)
+    if manifest.get("format_version") != _SHARDED_FORMAT_VERSION:
+        raise CheckpointError(
+            f"{ckpt_dir}: not a sharded checkpoint (format_version "
+            f"{manifest.get('format_version')})")
+    _validate_shards(ckpt_dir, manifest)
+
+
+def _assemble_leaf(f, rec: dict, tmpl: Any, ckpt_dir: str) -> Any:
+    """Reassemble ONE global leaf from its shard records and re-shard it
+    onto the template's sharding.  Peak host memory is the global leaf
+    plus one shard."""
+    key = rec["path"]
+    want_shape, want_dtype = leaf_spec(tmpl)
+    if (list(want_shape) != rec.get("shape")
+            or want_dtype.name != rec.get("dtype")):
+        raise CheckpointError(
+            f"{ckpt_dir}: leaf {key!r} is "
+            f"{rec.get('dtype')}{rec.get('shape')}, template wants "
+            f"{want_dtype.name}{list(want_shape)}")
+    try:
+        dtype = np_dtype(rec["dtype"])
+        out = np.empty(rec["shape"], dtype=dtype)
+    except Exception as e:
+        raise CheckpointError(
+            f"{ckpt_dir}: unusable leaf record {key!r}: "
+            f"{type(e).__name__}: {e}") from e
+    # an exact disjoint tiling is proven BEFORE any byte is placed —
+    # np.empty regions a gappy/overlapping shard list would leave
+    # unwritten must never reach the caller as heap garbage
+    _check_tiling(rec, ckpt_dir)
+    for shard in rec["shards"]:
+        arr = _read_shard(f, shard, rec, ckpt_dir)
+        index = tuple(slice(int(lo), int(hi)) for lo, hi in shard["index"])
+        out[index] = arr
+    return leaf_from_numpy(out, tmpl)
+
+
+def _load_validated_sharded(ckpt_dir: str, like: Any) -> tuple[Any, int]:
+    """Validate-and-load in one pass: every shard is CRC-checked as it
+    is placed, and the template's shape/dtype/structure is enforced both
+    ways — the same strictness as the whole-tree loader."""
+    manifest = _read_manifest(ckpt_dir)
+    if manifest.get("format_version") != _SHARDED_FORMAT_VERSION:
+        # a v1 candidate in a mixed root: the whole-tree loader owns it
+        # (including its matching-mesh requirement)
+        from apex_tpu.resilience.checkpoint import _load_validated
+
+        return _load_validated(ckpt_dir, like)
+    by_path = {r["path"]: r
+               for r in _iter_shard_records(manifest, ckpt_dir)
+               if isinstance(r.get("path"), str)}
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    with open(os.path.join(ckpt_dir, _DATA), "rb") as f:
+        for path, tmpl in flat:
+            key = jax.tree_util.keystr(path)
+            rec = by_path.get(key)
+            if rec is None:
+                raise CheckpointError(
+                    f"{ckpt_dir}: checkpoint has no leaf {key!r} "
+                    f"(template/checkpoint structure mismatch)")
+            leaves.append(_assemble_leaf(f, rec, tmpl, ckpt_dir))
+    extra = set(by_path) - {jax.tree_util.keystr(p) for p, _ in flat}
+    if extra:
+        raise CheckpointError(
+            f"{ckpt_dir}: checkpoint has leaves the template does not: "
+            f"{sorted(extra)[:5]}")
+    return jax.tree_util.tree_unflatten(treedef, leaves), manifest["step"]
+
+
+def restore_sharded_checkpoint(root: str, like: Any, *,
+                               step: Optional[int] = None
+                               ) -> tuple[Any, int]:
+    """Restore the newest *valid* checkpoint, resharding onto ``like``.
+
+    Every leaf is reassembled from its shard records and re-sharded onto
+    the corresponding template leaf's sharding — which may live on a
+    different mesh shape than the one that saved (the elastic-restart
+    contract).  Fallback semantics mirror
+    :func:`~apex_tpu.resilience.checkpoint.restore_checkpoint`: invalid
+    candidates are skipped with a ``checkpoint_rejected`` event, ``step``
+    pins an exact step, and :class:`CheckpointError` is raised when
+    nothing valid remains.  v1 (whole-tree) candidates in a mixed root
+    restore through the v1 loader, which requires a matching mesh.
+    """
+    candidates = ([step] if step is not None
+                  else list(reversed(_list_steps(root))))
+    errors: list[str] = []
+    for s in candidates:
+        ckpt_dir = os.path.join(root, _step_dirname(s))
+        t0 = time.monotonic()
+        try:
+            tree, got_step = _load_validated_sharded(ckpt_dir, like)
+        except CheckpointError as e:
+            errors.append(str(e))
+            emit_event("checkpoint_rejected", step=int(s), reason=str(e))
+            if step is not None:
+                raise
+            continue
+        emit_event("checkpoint_restored", step=int(got_step),
+                   fallback=bool(candidates[0] != s), sharded=True, t0=t0)
+        return tree, got_step
+    raise CheckpointError(
+        f"no valid checkpoint under {root!r}"
+        + (f"; rejected: {errors}" if errors else " (directory empty)"))
+
+
+@dataclasses.dataclass
+class ShardedCheckpointManager:
+    """Keep-last-K manager over one *sharded* checkpoint root.
+
+    Drop-in for :class:`~apex_tpu.resilience.checkpoint.CheckpointManager`
+    (same ``save``/``restore``/``latest_valid_step`` surface, so it slots
+    under :class:`~apex_tpu.resilience.supervisor.TrainingSupervisor`)
+    with mesh-elastic restore: the ``like`` template's shardings decide
+    the new layout.  When the training state is the STACKED per-replica
+    form, give the supervisor
+    ``persist_transform=``:func:`~apex_tpu.resilience.consistency.collapse_replicas`
+    — stacked global shapes depend on the dp world size, and persisting
+    them would defeat the elastic-restart contract.
+
+    >>> mgr = ShardedCheckpointManager("/ckpts/run7", keep=3)
+    >>> mgr.save(step, state)                      # mesh (dp=4, tp=2)
+    >>> state, resume = mgr.restore(like=template) # template on (dp=2, tp=4)
+    """
+
+    root: str
+    keep: int = 3
+    mesh: Optional[Mesh] = None
+    retry: Optional["RetryPolicy"] = None
+
+    def _retrying(self, fn, what: str):
+        if self.retry is None:
+            return fn()
+        from apex_tpu.resilience.retry import retry_transient
+
+        return retry_transient(fn, policy=self.retry, what=what)
+
+    def save(self, step: int, tree: Any, *, specs: Any = None) -> str:
+        return self._retrying(
+            lambda: save_sharded_checkpoint(self.root, step, tree,
+                                            mesh=self.mesh, specs=specs,
+                                            keep=self.keep),
+            "sharded_checkpoint_save")
+
+    def restore(self, like: Any, *, step: Optional[int] = None):
+        return self._retrying(
+            lambda: restore_sharded_checkpoint(self.root, like, step=step),
+            "sharded_checkpoint_restore")
+
+    def all_steps(self) -> list[int]:
+        return _list_steps(self.root)
+
+    def latest_valid_step(self) -> Optional[int]:
+        from apex_tpu.resilience.checkpoint import latest_valid_step
+
+        return latest_valid_step(self.root)
+
+    def checkpoint_path(self, step: int) -> str:
+        return os.path.join(self.root, _step_dirname(step))

@@ -34,6 +34,18 @@ step watchdog, transient retry, and data guard exist to survive:
   a chosen batch ahead of the clean one (NaN / shape / dtype damage),
   so a guarded run that skips it sees the identical clean stream as an
   unfaulted run — trajectory comparisons stay bit-exact.
+
+PR 3 adds the *pod-scale* faults the elastic/consistency layer exists
+to survive:
+
+- **Replica divergence**: :class:`DesyncReplica` perturbs ONE dp rank's
+  copy of one (seed- or name-chosen) leaf at a chosen host step — the
+  silent bit-rot a cross-replica hash pass must detect, localize, and
+  resync before the next all-reduce averages it into the whole pod.
+- **Shard corruption**: :class:`CorruptShardFile` flips bytes inside
+  exactly one shard record of a *sharded* (manifest v2) checkpoint, so
+  the per-shard CRCs localize the damage and the restore walk falls
+  back to the newest fully-valid step.
 """
 
 from __future__ import annotations
@@ -51,6 +63,8 @@ from apex_tpu._logging import emit_event
 
 __all__ = [
     "CorruptBatch",
+    "CorruptShardFile",
+    "DesyncReplica",
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
@@ -328,3 +342,159 @@ class CorruptBatch:
             self._pending = item
             return corrupted
         return item
+
+
+# -- pod-scale faults (PR 3) -----------------------------------------------
+
+
+class DesyncReplica:
+    """Silently diverge ONE dp rank's copy of one leaf at chosen steps.
+
+    Operates on the *stacked* per-replica representation (leaves with a
+    leading replica axis — see :mod:`apex_tpu.resilience.consistency`):
+    ``desync(state, step)`` returns ``state`` with a deterministic
+    perturbation added to one element of rank ``rank``'s slice of the
+    chosen leaf, and ``state`` unchanged off the configured steps.  The
+    perturbation is pure host-side array surgery — no collective runs,
+    no event fires beyond ``fault_injected`` — exactly the silent HBM
+    bit-rot / stale-update divergence a cross-replica hash pass exists
+    to catch before the next all-reduce averages it into the whole pod.
+
+    ``leaf`` selects the victim by keystr substring; None picks
+    seed-deterministically among the floating stacked leaves.  The
+    element offset within the slice is seed-chosen.
+    """
+
+    def __init__(self, steps: Iterable[int], *, rank: int = 1,
+                 leaf: Any = None, seed: int = 0, delta: float = 1e-3,
+                 axis_name: str = "dp"):
+        self.steps = frozenset(int(s) for s in steps)
+        self.rank = int(rank)
+        self.leaf = leaf
+        self.seed = int(seed)
+        self.delta = float(delta)
+        self.axis_name = axis_name
+
+    def _stacked(self, leaf: Any) -> bool:
+        """A perturbable per-replica leaf: non-empty floating array whose
+        leading axis is the replica stack (spec leads with the replica
+        axis when the leaf carries a NamedSharding; any leading axis
+        wider than ``rank`` qualifies for plain host arrays)."""
+        if np.ndim(leaf) < 1 or not np.size(leaf):
+            return False
+        try:
+            if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+                return False
+        except (AttributeError, TypeError):
+            return False
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "spec"):
+            from apex_tpu.resilience.consistency import _entry_names
+
+            spec = sharding.spec
+            lead = spec[0] if len(spec) else None
+            if self.axis_name not in _entry_names(lead):
+                return False
+        return np.shape(leaf)[0] > self.rank
+
+    def __call__(self, state: Any, step: int) -> Any:
+        if int(step) not in self.steps:
+            return state
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+        candidates = [
+            (i, jax.tree_util.keystr(path))
+            for i, (path, leaf) in enumerate(flat)
+            if self._stacked(leaf)
+            and (self.leaf is None or str(self.leaf) in
+                 jax.tree_util.keystr(path))]
+        if not candidates:
+            raise ValueError(
+                f"DesyncReplica(leaf={self.leaf!r}): no stacked floating "
+                f"leaf with a replica axis wider than rank {self.rank}")
+        rng = np.random.default_rng(self.seed)
+        idx, key = candidates[int(rng.integers(len(candidates)))]
+        _, victim = flat[idx]
+        sharding = getattr(victim, "sharding", None)
+        arr = np.array(jax.device_get(victim))  # writable host copy
+        slice_flat = arr[self.rank].reshape(-1)
+        pos = int(rng.integers(slice_flat.size))
+        cell = slice_flat[pos:pos + 1]
+        before = cell.tobytes()
+        cell[0] = cell[0] + np.asarray(self.delta, arr.dtype)
+        if cell.tobytes() == before:
+            # delta rounded away (low-precision dtype, large magnitude):
+            # the injection must still be a real byte-level divergence,
+            # so flip the lowest mantissa bit instead of silently no-oping
+            as_uint = cell.view(np.dtype(f"u{cell.dtype.itemsize}"))
+            as_uint[0] ^= 1
+        out = jnp.asarray(arr)
+        if sharding is not None:
+            out = jax.device_put(out, sharding)
+        leaves = [l for _, l in flat]
+        leaves[idx] = out
+        emit_event("fault_injected", fault="desync_replica", step=int(step),
+                   leaf=key, rank=self.rank, element=pos, delta=self.delta)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class CorruptShardFile:
+    """Flip bytes inside exactly ONE shard record of a v2 checkpoint.
+
+    The damage is confined to the chosen shard's byte extent in
+    ``data.bin`` — the manifest and every other shard stay intact — so
+    the per-shard CRCs must localize it (validation names the shard's
+    mesh coordinates and leaf) and the restore walk must fall back to
+    the newest fully-valid step.  ``leaf`` selects the victim leaf by
+    keystr substring (None: seed-chosen among leaves with non-empty
+    shards); ``shard`` indexes that leaf's shard list.  Returns what was
+    damaged, for assertions.
+    """
+
+    def __init__(self, *, leaf: Any = None, shard: int = 0,
+                 nbytes: int = 4, seed: int = 0):
+        if nbytes < 1:
+            raise ValueError(f"nbytes must be >= 1, got {nbytes}")
+        self.leaf = leaf
+        self.shard = int(shard)
+        self.nbytes = int(nbytes)
+        self.seed = int(seed)
+
+    def __call__(self, ckpt_dir: str) -> dict:
+        import json
+
+        with open(os.path.join(ckpt_dir, "manifest.json")) as f:
+            manifest = json.load(f)
+        if manifest.get("format_version") != 2:
+            raise ValueError(
+                f"{ckpt_dir}: CorruptShardFile needs a sharded (v2) "
+                f"checkpoint, got format_version "
+                f"{manifest.get('format_version')}")
+        recs = [r for r in manifest["leaves"]
+                if r.get("shards")
+                and any(s.get("nbytes") for s in r["shards"])
+                and (self.leaf is None or str(self.leaf) in r["path"])]
+        if not recs:
+            raise ValueError(
+                f"{ckpt_dir}: no leaf matching {self.leaf!r} with a "
+                f"non-empty shard to corrupt")
+        rng = np.random.default_rng(self.seed)
+        rec = recs[int(rng.integers(len(recs)))]
+        shards = [s for s in rec["shards"] if s.get("nbytes")]
+        shard = shards[self.shard % len(shards)]
+        offsets = sorted(
+            int(shard["offset"]) + int(o)
+            for o in rng.choice(int(shard["nbytes"]),
+                                size=min(self.nbytes, int(shard["nbytes"])),
+                                replace=False))
+        path = os.path.join(ckpt_dir, "data.bin")
+        with open(path, "r+b") as f:
+            for off in offsets:
+                f.seek(off)
+                byte = f.read(1)[0]
+                f.seek(off)
+                f.write(bytes([byte ^ 0xFF]))
+        emit_event("fault_injected", fault="shard_corruption", path=path,
+                   leaf=rec["path"], coords=shard.get("coords"),
+                   offsets=offsets)
+        return {"leaf": rec["path"], "coords": shard.get("coords"),
+                "offsets": offsets}

@@ -55,6 +55,7 @@ from apex_tpu.resilience.checkpoint import (
     CheckpointManager,
     validate_checkpoint,
 )
+from apex_tpu.resilience.consistency import ReplicaDesyncError
 from apex_tpu.resilience.data_guard import DataStallError, SkipBudgetExceeded
 from apex_tpu.resilience.retry import (
     RetryExhausted,
@@ -127,6 +128,18 @@ def write_heartbeat(path: str, step: int, *,
         "ckpt_path": ckpt_path,
         "stalled": bool(stalled),
     }
+    # which slice member wrote this heartbeat: on a pod the orchestrator
+    # watches one file per process and needs the mesh coordinates to
+    # requeue the RIGHT slice, not just "a worker" (ISSUE 3 satellite)
+    try:
+        from apex_tpu.transformer import parallel_state
+
+        if parallel_state.model_parallel_is_initialized():
+            payload["rank_info"] = parallel_state.get_rank_info()
+            payload["mesh"] = parallel_state.mesh_axis_sizes()
+    except Exception as e:  # liveness probe must outlive rank plumbing
+        logger.debug("heartbeat rank info unavailable: %s: %s",
+                     type(e).__name__, e)
     # thread ident in the temp name: the monitor thread (stall marker)
     # and the main thread (beat) share a pid and may write concurrently —
     # each needs its own temp file for os.replace to stay atomic
@@ -343,12 +356,18 @@ class SupervisorConfig:
     ``max_consecutive_failures`` is the graceful-degradation trigger:
     that many *unrecovered* failures in a row write an emergency
     checkpoint and abort cleanly.  ``retry`` governs every host-I/O
-    retry (data fetch, checkpoint save)."""
+    retry (data fetch, checkpoint save).
+    ``consistency_check_interval`` runs the supervisor's
+    :class:`~apex_tpu.resilience.consistency.ReplicaConsistency` pass
+    every that many steps (0 disables); a desync the pass cannot repair
+    escalates through the same failure ladder as every other
+    unrecovered failure."""
 
     step_deadline_s: float = 1800.0
     poll_interval_s: Optional[float] = None
     max_consecutive_failures: int = 3
     checkpoint_every: int = 1
+    consistency_check_interval: int = 0
     heartbeat_path: Optional[str] = None
     retry: RetryPolicy = RetryPolicy()
 
@@ -359,6 +378,8 @@ class SupervisorConfig:
             raise ValueError("max_consecutive_failures must be >= 1")
         if self.checkpoint_every < 1:
             raise ValueError("checkpoint_every must be >= 1")
+        if self.consistency_check_interval < 0:
+            raise ValueError("consistency_check_interval must be >= 0")
 
 
 class TrainingSupervisor:
@@ -373,12 +394,20 @@ class TrainingSupervisor:
       producer errors cost attempts, not the run);
     - every step bracketed by the watchdog;
     - a heartbeat + periodic validated checkpoint after each step;
+    - a periodic cross-replica consistency pass (``consistency=`` a
+      :class:`~apex_tpu.resilience.consistency.ReplicaConsistency`, run
+      every ``config.consistency_check_interval`` steps *before* the
+      checkpoint commit) — silent replica divergence is detected,
+      localized, and resynced in place; an unrepairable desync counts
+      as an unrecovered failure;
     - an escalating consecutive-failure counter over the supervisor's
       failure domain (:class:`StepDeadlineExceeded`,
       :class:`~apex_tpu.resilience.retry.RetryExhausted`,
       :class:`~apex_tpu.resilience.data_guard.SkipBudgetExceeded`,
-      :class:`~apex_tpu.resilience.data_guard.DataStallError`) — any
-      other exception is not the supervisor's to absorb and propagates.
+      :class:`~apex_tpu.resilience.data_guard.DataStallError`,
+      :class:`~apex_tpu.resilience.consistency.ReplicaDesyncError`) —
+      any other exception is not the supervisor's to absorb and
+      propagates.
 
     A slow-but-finished step keeps its result (the work is real) but
     counts as a failure; escalation therefore checkpoints the *newest*
@@ -387,15 +416,20 @@ class TrainingSupervisor:
     """
 
     FAILURE_DOMAIN = (StepDeadlineExceeded, RetryExhausted,
-                      SkipBudgetExceeded, DataStallError)
+                      SkipBudgetExceeded, DataStallError,
+                      ReplicaDesyncError)
 
     def __init__(self, manager: Optional[CheckpointManager] = None,
                  config: SupervisorConfig = SupervisorConfig(), *,
+                 consistency=None,
+                 persist_transform: Optional[Callable[[Any], Any]] = None,
                  timers=None,
                  clock: Callable[[], float] = time.monotonic,
                  sleep: Callable[[float], None] = time.sleep):
         self.manager = manager
         self.config = config
+        self.consistency = consistency
+        self.persist_transform = persist_transform
         self.consecutive_failures = 0
         self._sleep = sleep
         self.watchdog = StepWatchdog(
@@ -466,7 +500,16 @@ class TrainingSupervisor:
         """One retried save.  A manager constructed with its own
         ``retry`` policy already wraps ``save`` in ``retry_transient``
         (the documented recipe does exactly that) — defer to it rather
-        than nesting two loops into ``max_attempts**2`` save attempts."""
+        than nesting two loops into ``max_attempts**2`` save attempts.
+
+        ``persist_transform`` (when set) maps the live state to its
+        persistable form first — the stacked-per-replica workflow passes
+        :func:`~apex_tpu.resilience.consistency.collapse_replicas` here
+        so every periodic AND emergency checkpoint stores the
+        mesh-shape-free logical copy an elastic restart can reshard,
+        never the dp-world-size-dependent stacked form."""
+        if self.persist_transform is not None:
+            state = self.persist_transform(state)
         if self.manager.retry is not None:
             return self.manager.save(int(step), state)
         return retry_transient(
@@ -487,6 +530,11 @@ class TrainingSupervisor:
         it = iter(batches)
         step = int(start_step)
         last_completed = step - 1
+        # STICKY across steps: once a consistency pass fails, the state
+        # stays untrusted (no commit, no failure-counter reset) until a
+        # later pass proves it clean — steps BETWEEN interval checks
+        # neither re-earn trust nor bury the standing divergence
+        state_trusted = True
         self.watchdog.start()
         try:
             while step < num_steps:
@@ -509,18 +557,46 @@ class TrainingSupervisor:
                 except BaseException:
                     self.watchdog.cancel()  # not a deadline event
                     raise
+                step_ok = True
                 try:
                     self.watchdog.disarm()
-                    self.record_success()
                 except StepDeadlineExceeded as e:
                     # late but finished: keep the result, count the miss
+                    step_ok = False
                     self.record_failure(step, new_state, e)  # may abort
                 state = new_state
                 last_completed = step
 
+                # -- cross-replica consistency, BEFORE the checkpoint
+                # commit: a desynced state must never be persisted, and a
+                # resynced repair is what the periodic save should carry
+                if (self.consistency is not None
+                        and self.config.consistency_check_interval
+                        and (step + 1)
+                        % self.config.consistency_check_interval == 0):
+                    try:
+                        state = self.consistency.check(state, step=step)
+                        state_trusted = True  # proven clean (or repaired)
+                    except ReplicaDesyncError as e:
+                        # unrepaired divergence: one unrecovered failure
+                        # (escalates to emergency-checkpoint + abort at
+                        # the threshold, like every other failure kind);
+                        # commits are SKIPPED until a later pass proves
+                        # the state clean — it must not become
+                        # latest_valid_step and survive the restart
+                        step_ok = False
+                        state_trusted = False
+                        self.record_failure(step, state, e)
+                # the consecutive-failure counter resets only while the
+                # state is trusted — otherwise a desync that re-proves
+                # itself every interval would be buried by the
+                # intervening successful steps and never escalate
+                if step_ok and state_trusted:
+                    self.record_success()
+
                 # -- commit host-side progress
                 ckpt_path = None
-                if self.manager is not None and (
+                if self.manager is not None and state_trusted and (
                         (step + 1) % self.config.checkpoint_every == 0
                         or step + 1 >= num_steps):
                     try:

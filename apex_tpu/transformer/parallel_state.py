@@ -235,3 +235,16 @@ def get_rank_info() -> str:
             f"pp={get_pipeline_model_parallel_world_size()}, "
             f"tp={get_tensor_model_parallel_world_size()}), "
             f"process={jax.process_index()}")
+
+
+def mesh_axis_sizes() -> Optional[dict]:
+    """``{'dp': N, 'pp': N, 'tp': N}`` of the installed mesh, or None.
+
+    The machine-readable companion of :func:`get_rank_info` — checkpoint
+    manifests and orchestrator heartbeats embed this so an external
+    restart can tell *which* mesh shape wrote a file without parsing
+    prose (elastic restarts resume onto whatever slice is available).
+    """
+    if _MESH is None:
+        return None
+    return {name: int(size) for name, size in _MESH.shape.items()}

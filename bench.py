@@ -191,9 +191,11 @@ def _run_external(name: str, *, batch, steps, seq) -> dict:
 
 
 # Diagnostic blocks riding every captured config: ``recovery`` (checkpoint
-# save/validate/restore on the live train state, below) and ``supervisor``
+# save/validate/restore on the live train state, below), ``supervisor``
 # (_supervisor_metrics: watchdog arm/disarm, heartbeat write, retry path)
-# keep the robustness tax visible in the BENCH trajectory.
+# and ``elastic`` (_elastic_metrics: sharded save + dp 4->2->8 reshard
+# restore, replica-hash verify) keep the robustness tax visible in the
+# BENCH trajectory.
 
 # resilience-overhead capture: checkpointing the full 774M train state
 # (~9 GB with optimizer moments) through the tunnel would dominate the
@@ -303,6 +305,80 @@ def _supervisor_metrics(n: int = 2000) -> dict:
         "watchdog_arm_disarm_us_per_step": round(arm_disarm_us, 3),
         "heartbeat_write_ms": round(heartbeat_ms, 3),
         "retry_2fail_recovered_ms": round(retry_ms, 3),
+    }
+
+
+def _elastic_metrics(rows: int = 512, cols: int = 1024) -> dict:
+    """Elastic-restart tax of the ISSUE-3 layer (the BENCH_*.json
+    ``elastic`` block): sharded (manifest v2) save wall time + bytes on a
+    ``(dp=4, tp=2)`` mesh, reshard-restore wall time onto ``(dp=2, tp=4)``
+    and ``(dp=8, tp=1)`` — the pod-resize path — and the steady-state
+    cross-replica hash-verify pass (compile excluded by a warmup call).
+    Needs 8 devices (the suite's virtual-CPU mesh, or a real slice)."""
+    import shutil
+    import tempfile
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from apex_tpu.resilience import consistency as cons
+    from apex_tpu.resilience import elastic as el
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        return {"ok": False,
+                "error": f"needs 8 devices, have {len(devs)}"}
+    devs = np.array(devs[:8])
+    meshes = {4: Mesh(devs.reshape(4, 2), ("dp", "tp")),
+              2: Mesh(devs.reshape(2, 4), ("dp", "tp")),
+              8: Mesh(devs.reshape(8, 1), ("dp", "tp"))}
+
+    def logical(mesh):
+        # one tp-sharded matrix + one replicated vector: the two shard
+        # geometries every transformer state mixes
+        w = jnp.arange(rows * cols, dtype=jnp.float32).reshape(rows, cols)
+        return {"w": jax.device_put(w, NamedSharding(mesh, P(None, "tp"))),
+                "b": jax.device_put(jnp.ones((cols,), jnp.float32),
+                                    NamedSharding(mesh, P("tp")))}
+
+    state = logical(meshes[4])
+    total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree.leaves(state))
+    root = tempfile.mkdtemp(prefix="bench_elastic_")
+    try:
+        t0 = time.perf_counter()
+        path = el.save_sharded_checkpoint(root, 0, state, mesh=meshes[4])
+        t_save = time.perf_counter() - t0
+        import json as _json
+
+        with open(os.path.join(path, "manifest.json")) as f:
+            n_shards = sum(len(r["shards"])
+                           for r in _json.load(f)["leaves"])
+        restore_ms = {}
+        for dp in (2, 8):
+            like = logical(meshes[dp])
+            t0 = time.perf_counter()
+            tree, _ = el.restore_sharded_checkpoint(root, like)
+            jax.block_until_ready(tree)
+            restore_ms[dp] = (time.perf_counter() - t0) * 1e3
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    stacked = cons.expand_replicas(state, meshes[4])
+    cons.verify_replicas(stacked, mesh=meshes[4], emit=False)  # warmup
+    t0 = time.perf_counter()
+    report = cons.verify_replicas(stacked, mesh=meshes[4], emit=False)
+    verify_ms = (time.perf_counter() - t0) * 1e3
+    assert not report, f"clean state reported desync: {report}"
+
+    return {
+        "ok": True,
+        "bytes": total,
+        "n_shards": n_shards,
+        "save_dp4_ms": round(t_save * 1e3, 2),
+        "restore_dp2_ms": round(restore_ms[2], 2),
+        "restore_dp8_ms": round(restore_ms[8], 2),
+        "save_mb_per_s": round(total / 2**20 / max(t_save, 1e-9), 1),
+        "verify_replicas_ms": round(verify_ms, 2),
     }
 
 
@@ -455,6 +531,10 @@ def run_config(name: str, *, batch: int | None = None,
         supervisor = _supervisor_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         supervisor = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        elastic = _elastic_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        elastic = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -467,6 +547,7 @@ def run_config(name: str, *, batch: int | None = None,
         "device": str(dev.device_kind),
         "recovery": recovery,
         "supervisor": supervisor,
+        "elastic": elastic,
         "config": out_cfg,
     }
 
@@ -625,7 +706,10 @@ def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
             raise subprocess.CalledProcessError(proc.returncode, proc.args)
         return json.loads(proc.stdout.strip().splitlines()[-1])
 
-    from jax import shard_map
+    try:  # jax >= 0.6 exports it at top level
+        from jax import shard_map
+    except ImportError:  # jax 0.4.x
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.optimizers import FusedAdam, FusedLAMB

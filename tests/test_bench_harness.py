@@ -232,6 +232,21 @@ def test_supervisor_metrics_block():
     assert r["watchdog_arm_disarm_us_per_step"] < 1000.0
 
 
+def test_elastic_metrics_block():
+    """The elastic-restart block (ISSUE 3 satellite): sharded save on
+    (dp=4, tp=2), reshard-restore onto dp=2 and dp=8, and the
+    steady-state replica-hash verify pass — all on the suite's
+    8-virtual-CPU-device mesh."""
+    r = bench._elastic_metrics(rows=64, cols=64)
+    assert r["ok"] is True
+    assert r["bytes"] == 64 * 64 * 4 + 64 * 4
+    # tp=2 cuts w and b into 2 shards each at save time
+    assert r["n_shards"] == 4
+    for k in ("save_dp4_ms", "restore_dp2_ms", "restore_dp8_ms",
+              "verify_replicas_ms"):
+        assert r[k] > 0.0, k
+
+
 def test_cpu_smoke_end_to_end(monkeypatch):
     """The real measurement path on the real (CPU) backend.
 
@@ -251,3 +266,4 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     # the diagnostic blocks ride every captured config
     assert result["recovery"]["ok"] is True
     assert result["supervisor"]["ok"] is True
+    assert result["elastic"]["ok"] is True

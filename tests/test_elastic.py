@@ -1,0 +1,797 @@
+"""Elastic sharded checkpointing + cross-replica consistency (ISSUE 3).
+
+Covers the tentpole end to end on the suite's 8-virtual-CPU-device mesh:
+shard-grid geometry, sharded save/validate/restore with resharding onto
+a *different* mesh shape, per-shard corruption localization + fallback,
+cross-replica hash verification / desync localization / resync repair,
+the supervisor's ``consistency_check_interval`` wiring, and THE
+acceptance run — train on ``(dp=4, tp=2)``, inject ``DesyncReplica``
+(detected, localized, resynced, trajectory bit-matches the clean run),
+save sharded, restart on ``(dp=2, tp=4)`` and ``dp=8`` bit-identically,
+and fall back past a ``CorruptShardFile``-damaged newest checkpoint.
+"""
+
+import json
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu import resilience as rz
+from apex_tpu.resilience.consistency import _SHARD_MAP_KW, _shard_map
+from apex_tpu.resilience.elastic import _shard_grid, _spec_entries
+
+
+@pytest.fixture
+def events():
+    """Capture structured apex_tpu.events as parsed dicts."""
+    records = []
+    handler = logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = logging.getLogger("apex_tpu.events")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+
+    def get(kind=None):
+        parsed = [json.loads(r) for r in records]
+        return parsed if kind is None else [e for e in parsed
+                                            if e["event"] == kind]
+
+    yield get
+    logger.removeHandler(handler)
+
+
+def _mesh(devices, dp, tp):
+    return Mesh(np.array(devices[:8]).reshape(dp, tp), ("dp", "tp"))
+
+
+@pytest.fixture
+def mesh42(devices):
+    return _mesh(devices, 4, 2)
+
+
+@pytest.fixture
+def mesh24(devices):
+    return _mesh(devices, 2, 4)
+
+
+@pytest.fixture
+def mesh81(devices):
+    return _mesh(devices, 8, 1)
+
+
+def _host(leaf):
+    from apex_tpu.utils.serialization import leaf_to_numpy
+
+    return leaf_to_numpy(leaf)
+
+
+def _tree_equal(a, b):
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = _host(x), _host(y)
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(x, y)
+
+
+# --------------------------------------------------------------------------
+# shard-grid geometry
+# --------------------------------------------------------------------------
+
+
+class TestShardGeometry:
+    def test_spec_normalization(self):
+        assert _spec_entries(None, 2) == [(), ()]
+        assert _spec_entries(P("tp"), 2) == [("tp",), ()]
+        assert _spec_entries(P(None, ("dp", "tp")), 2) == [(), ("dp", "tp")]
+
+    def test_grid_covers_leaf_exactly(self):
+        sizes = {"dp": 4, "tp": 2}
+        grid = list(_shard_grid([("tp",), ()], (8, 3), sizes, "x"))
+        assert len(grid) == 2  # only 'tp' partitions
+        assert [g[1] for g in grid] == [[[0, 4], [0, 3]], [[4, 8], [0, 3]]]
+        assert [g[0] for g in grid] == [{"tp": 0}, {"tp": 1}]
+
+    def test_tuple_entry_splits_major_to_minor(self):
+        sizes = {"dp": 2, "tp": 2}
+        grid = list(_shard_grid([(("dp", "tp"))], (8,), sizes, "x"))
+        # dp major, tp minor: (dp, tp) -> start = (dp*2 + tp) * 2
+        assert [g[1][0] for g in grid] == [
+            [0, 2], [2, 4], [4, 6], [6, 8]]
+
+    def test_replicated_leaf_is_one_shard(self):
+        grid = list(_shard_grid([(), ()], (4, 4), {"dp": 8}, "x"))
+        assert grid == [({}, [[0, 4], [0, 4]])]
+
+    def test_uneven_dim_raises(self):
+        with pytest.raises(rz.CheckpointError, match="not divisible"):
+            list(_shard_grid([("tp",)], (7,), {"tp": 2}, "x"))
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(rz.CheckpointError, match="not a mesh axis"):
+            list(_shard_grid([("zz",)], (8,), {"tp": 2}, "x"))
+
+
+# --------------------------------------------------------------------------
+# sharded checkpoints: save / validate / restore / reshard
+# --------------------------------------------------------------------------
+
+
+def _sharded_tree(mesh):
+    """Representative state: tp-sharded matrix, dp+tp 2-D sharded matrix,
+    replicated vector, scalar, typed PRNG key."""
+    return {
+        "w": jax.device_put(
+            jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+            NamedSharding(mesh, P(None, "tp"))),
+        "m": jax.device_put(
+            jnp.arange(32, dtype=jnp.bfloat16).reshape(8, 4),
+            NamedSharding(mesh, P("dp", "tp"))),
+        "b": jax.device_put(jnp.ones((6,), jnp.float32),
+                            NamedSharding(mesh, P())),
+        "step": jnp.int32(7),
+        "rng": jax.random.key(3),
+    }
+
+
+class TestShardedCheckpoint:
+    def test_roundtrip_same_mesh_bit_identical(self, tmp_path, mesh42):
+        tree = _sharded_tree(mesh42)
+        path = rz.save_sharded_checkpoint(str(tmp_path), 5, tree,
+                                          mesh=mesh42)
+        rz.validate_sharded_checkpoint(path)
+        restored, step = rz.restore_sharded_checkpoint(
+            str(tmp_path), _sharded_tree(mesh42))
+        assert step == 5
+        _tree_equal(tree, restored)
+
+    @pytest.mark.parametrize("shape", [(2, 4), (8, 1)])
+    def test_reshard_onto_different_mesh_bit_identical(
+            self, tmp_path, devices, mesh42, shape):
+        tree = _sharded_tree(mesh42)
+        rz.save_sharded_checkpoint(str(tmp_path), 0, tree, mesh=mesh42)
+        target = _mesh(devices, *shape)
+        restored, _ = rz.restore_sharded_checkpoint(
+            str(tmp_path), _sharded_tree(target))
+        _tree_equal(tree, restored)
+        # the restored leaves live on the TARGET mesh's shardings
+        assert restored["w"].sharding.mesh.shape == dict(target.shape)
+
+    def test_manifest_v2_schema(self, tmp_path, mesh42):
+        path = rz.save_sharded_checkpoint(
+            str(tmp_path), 0, _sharded_tree(mesh42), mesh=mesh42)
+        with open(os.path.join(path, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["format_version"] == 2 and man["sharded"] is True
+        assert man["mesh"]["axes"] == {"dp": 4, "tp": 2}
+        assert man["mesh"]["dp"] == 4 and man["mesh"]["tp"] == 2
+        assert man["mesh"]["world"] == 8
+        by_path = {r["path"]: r for r in man["leaves"]}
+        w = by_path["['w']"]
+        assert w["shape"] == [8, 8]          # GLOBAL shape
+        assert len(w["shards"]) == 2         # tp=2 column blocks
+        assert {tuple(s["coords"].items()) for s in w["shards"]} == {
+            (("tp", 0),), (("tp", 1),)}
+        m = by_path["['m']"]
+        assert len(m["shards"]) == 8         # dp=4 x tp=2 grid
+        for s in m["shards"]:
+            assert "crc32" in s and "index" in s and "offset" in s
+        # replicated leaves are one shard with empty coords
+        assert len(by_path["['b']"]["shards"]) == 1
+        assert by_path["['b']"]["shards"][0]["coords"] == {}
+
+    def test_specs_override_without_shardings(self, tmp_path, mesh42):
+        """Host arrays + an explicit specs pytree shard the same way a
+        NamedSharding-carrying tree does."""
+        tree = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+        rz.save_sharded_checkpoint(
+            str(tmp_path), 0, tree, mesh=mesh42,
+            specs={"w": P(None, "tp")})
+        restored, _ = rz.restore_sharded_checkpoint(
+            str(tmp_path), {"w": jnp.zeros((4, 4), jnp.float32)})
+        _tree_equal(tree, restored)
+
+    def test_validate_rejects_v1_dir(self, tmp_path):
+        rz.save_checkpoint(str(tmp_path), 0, {"x": jnp.ones(3)})
+        v1 = os.path.join(str(tmp_path), sorted(os.listdir(tmp_path))[0])
+        with pytest.raises(rz.CheckpointError, match="not a sharded"):
+            rz.validate_sharded_checkpoint(v1)
+
+    def test_validate_checkpoint_dispatches_to_shards(self, tmp_path,
+                                                      mesh42):
+        """checkpoint.validate_checkpoint (and therefore
+        latest_valid_step / the supervisor's emergency validation) walks
+        v2 dirs shard-by-shard."""
+        path = rz.save_sharded_checkpoint(
+            str(tmp_path), 3, _sharded_tree(mesh42), mesh=mesh42)
+        rz.validate_checkpoint(path)          # v1 entry point, v2 dir
+        assert rz.latest_valid_step(str(tmp_path)) == 3
+        rz.CorruptShardFile(leaf="w", seed=0)(path)
+        with pytest.raises(rz.CheckpointError, match="CRC mismatch"):
+            rz.validate_checkpoint(path)
+        assert rz.latest_valid_step(str(tmp_path)) is None
+
+    def test_v1_loader_refuses_v2_dir(self, tmp_path, mesh42):
+        rz.save_sharded_checkpoint(
+            str(tmp_path), 0, _sharded_tree(mesh42), mesh=mesh42)
+        with pytest.raises(rz.CheckpointError, match="sharded"):
+            rz.restore_checkpoint(str(tmp_path), _sharded_tree(mesh42))
+
+    def test_template_mismatches_name_keystr(self, tmp_path, mesh42):
+        tree = {"w": jax.device_put(
+            jnp.ones((4, 4), jnp.float32),
+            NamedSharding(mesh42, P(None, "tp")))}
+        rz.save_sharded_checkpoint(str(tmp_path), 0, tree, mesh=mesh42)
+        with pytest.raises(rz.CheckpointError, match=r"\['w'\]"):
+            rz.restore_sharded_checkpoint(
+                str(tmp_path), {"w": jnp.ones((4, 2), jnp.float32)},
+                step=0)
+        with pytest.raises(rz.CheckpointError, match=r"\['w'\]"):
+            rz.restore_sharded_checkpoint(
+                str(tmp_path), {"w": jnp.ones((4, 4), jnp.bfloat16)},
+                step=0)
+        with pytest.raises(rz.CheckpointError, match=r"no leaf \"\['v'\]\""):
+            rz.restore_sharded_checkpoint(
+                str(tmp_path), {"v": jnp.ones((4, 4), jnp.float32)},
+                step=0)
+        with pytest.raises(rz.CheckpointError, match=r"no leaf \"\['x'\]\""):
+            # template leaf the checkpoint lacks
+            rz.restore_sharded_checkpoint(
+                str(tmp_path), {"w": jnp.ones((4, 4), jnp.float32),
+                                "x": jnp.ones(2)}, step=0)
+
+    def test_superset_checkpoint_names_extra_leaf(self, tmp_path, mesh42):
+        tree = {"w": jnp.ones((4, 4), jnp.float32),
+                "legacy": jnp.ones((2,), jnp.float32)}
+        rz.save_sharded_checkpoint(str(tmp_path), 0, tree, mesh=mesh42)
+        with pytest.raises(rz.CheckpointError,
+                           match=r"template does not.*\['legacy'\]"):
+            rz.restore_sharded_checkpoint(
+                str(tmp_path), {"w": jnp.ones((4, 4), jnp.float32)},
+                step=0)
+
+    def test_mixed_root_falls_back_across_formats(self, tmp_path, mesh42,
+                                                  events):
+        """A root mixing v1 and v2 dirs: the sharded restore walk loads
+        whichever format the newest VALID candidate carries."""
+        host = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+        rz.save_checkpoint(str(tmp_path), 0, host)            # v1
+        rz.save_sharded_checkpoint(str(tmp_path), 1, host,    # v2
+                                   mesh=mesh42,
+                                   specs={"w": P(None, "tp")})
+        dmg = rz.CorruptShardFile(seed=2)(
+            os.path.join(str(tmp_path), "step_0000000001"))
+        assert dmg["leaf"] == "['w']"
+        restored, step = rz.restore_sharded_checkpoint(
+            str(tmp_path), {"w": jnp.zeros((4, 4), jnp.float32)})
+        assert step == 0                                      # fell back to v1
+        _tree_equal(host, restored)
+        assert any(e["step"] == 1 for e in events("checkpoint_rejected"))
+
+    def test_rotation_and_manager_surface(self, tmp_path, mesh42):
+        mgr = rz.ShardedCheckpointManager(str(tmp_path), keep=2,
+                                          mesh=mesh42)
+        tree = {"w": jnp.ones((4, 4), jnp.float32)}
+        for s in range(5):
+            mgr.save(s, tree, specs={"w": P(None, "tp")})
+        assert mgr.all_steps() == [3, 4]
+        assert mgr.latest_valid_step() == 4
+        restored, step = mgr.restore(
+            like={"w": jnp.zeros((4, 4), jnp.float32)})
+        assert step == 4
+        _tree_equal(tree, restored)
+
+    def test_overlapping_shard_indices_rejected(self, tmp_path, mesh42):
+        """A damaged-but-parsable manifest whose shard indices overlap
+        (per-shard CRCs still pass — they cover bytes, not index
+        semantics) must be rejected, not reassembled around np.empty
+        garbage."""
+        tree = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+        path = rz.save_sharded_checkpoint(str(tmp_path), 0, tree,
+                                          mesh=mesh42,
+                                          specs={"w": P(None, "tp")})
+        mp = os.path.join(path, "manifest.json")
+        with open(mp) as f:
+            man = json.load(f)
+        shards = man["leaves"][0]["shards"]
+        # both shards claim the SAME column block: byte totals still
+        # look complete, columns 2-3 would be uninitialized memory
+        shards[1]["index"] = shards[0]["index"]
+        with open(mp, "w") as f:
+            json.dump(man, f)
+        like = {"w": jnp.zeros((4, 4), jnp.float32)}
+        with pytest.raises(rz.CheckpointError, match="duplicate shard"):
+            rz.validate_sharded_checkpoint(path)
+        with pytest.raises(rz.CheckpointError, match="duplicate shard"):
+            rz.restore_sharded_checkpoint(str(tmp_path), like, step=0)
+        # gap variant: a shifted, non-chaining interval
+        shards[1]["index"] = [[0, 4], [1, 3]]
+        with open(mp, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(rz.CheckpointError, match="do not tile"):
+            rz.restore_sharded_checkpoint(str(tmp_path), like, step=0)
+
+    def test_damaged_shape_record_rejects_not_crashes(self, tmp_path,
+                                                      mesh42):
+        """A parsable manifest whose leaf 'shape' is not a list must come
+        back as CheckpointError — latest_valid_step and the fallback
+        walk only skip CheckpointError, so a raw TypeError would crash
+        the recovery path itself."""
+        tree = {"w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4)}
+        path = rz.save_sharded_checkpoint(str(tmp_path), 0, tree,
+                                          mesh=mesh42,
+                                          specs={"w": P(None, "tp")})
+        mp = os.path.join(path, "manifest.json")
+        with open(mp) as f:
+            man = json.load(f)
+        man["leaves"][0]["shape"] = 16  # int, not a list
+        with open(mp, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(rz.CheckpointError, match="unusable shape"):
+            rz.validate_sharded_checkpoint(path)
+        assert rz.latest_valid_step(str(tmp_path)) is None
+
+    def test_duplicate_axis_spec_rejected_at_save(self, tmp_path, mesh42):
+        """A spec that repeats a mesh axis would emit duplicate shard
+        indices — an unrestorable checkpoint save must refuse to write."""
+        tree = {"w": jnp.ones((8, 8), jnp.float32)}
+        with pytest.raises(rz.CheckpointError, match="more than once"):
+            rz.save_sharded_checkpoint(str(tmp_path), 0, tree,
+                                       mesh=mesh42,
+                                       specs={"w": P("tp", "tp")})
+        assert not any(n.startswith("step_")
+                       for n in os.listdir(tmp_path))
+
+    def test_uneven_shard_dim_raises_at_save(self, tmp_path, mesh42):
+        tree = {"w": jnp.ones((7, 4), jnp.float32)}
+        with pytest.raises(rz.CheckpointError, match="not divisible"):
+            rz.save_sharded_checkpoint(str(tmp_path), 0, tree,
+                                       mesh=mesh42,
+                                       specs={"w": P("dp", None)})
+
+
+# --------------------------------------------------------------------------
+# cross-replica consistency
+# --------------------------------------------------------------------------
+
+
+def _stacked_state(mesh, seed=0):
+    """Per-replica stacked params: leading 'dp' replica axis, tp-sharded
+    second matrix dim, plus a logically-shared (non-stacked) scalar."""
+    dp = int(mesh.shape["dp"])
+    rng = np.random.default_rng(seed)
+    w = jnp.asarray(rng.standard_normal((8, 8)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    logical = {
+        "w": jax.device_put(w, NamedSharding(mesh, P(None, "tp"))),
+        "b": jax.device_put(b, NamedSharding(mesh, P("tp"))),
+    }
+    state = rz.expand_replicas(logical, mesh)
+    state["shared"] = jax.device_put(
+        jnp.float32(1.5), NamedSharding(mesh, P()))
+    return state
+
+
+class TestConsistency:
+    def test_clean_state_verifies_empty(self, mesh42):
+        assert rz.verify_replicas(_stacked_state(mesh42),
+                                  mesh=mesh42) == []
+
+    def test_replica_hashes_shape_and_agreement(self, mesh42):
+        rec = rz.replica_hashes(_stacked_state(mesh42), mesh=mesh42)
+        assert set(rec) == {"['b']", "['w']"}
+        for r in rec.values():
+            assert r["hashes"].shape == (4,)
+            assert len(set(int(h) for h in r["hashes"])) == 1
+            np.testing.assert_array_equal(r["max_abs_delta"], 0.0)
+
+    def test_desync_localized_to_leaf_and_rank(self, mesh42, events):
+        state = _stacked_state(mesh42)
+        bad = rz.DesyncReplica([2], rank=3, leaf="w", delta=0.25)(state, 2)
+        report = rz.verify_replicas(bad, mesh=mesh42, step=2)
+        assert len(report) == 1
+        d = report[0]
+        assert d.path == "['w']" and d.ranks == (3,)
+        assert d.max_abs_delta == pytest.approx(0.25, rel=1e-5)
+        [ev] = events("replica_desync")
+        assert ev["leaf"] == "['w']" and ev["ranks"] == [3]
+        assert ev["step"] == 2
+        [fail] = events("replica_verify_failed")
+        assert fail["diverged_leaves"] == ["['w']"]
+
+    def test_desync_off_step_is_identity(self, mesh42):
+        state = _stacked_state(mesh42)
+        assert rz.DesyncReplica([5])(state, 4) is state
+
+    def test_desync_without_candidate_raises(self, mesh42):
+        with pytest.raises(ValueError, match="no stacked floating"):
+            rz.DesyncReplica([0], leaf="nope")(
+                _stacked_state(mesh42), 0)
+
+    def test_resync_repairs_bit_identically(self, mesh42):
+        state = _stacked_state(mesh42)
+        bad = rz.DesyncReplica([0], rank=2, leaf="b")(state, 0)
+        fixed = rz.resync_replicas(bad, mesh=mesh42)
+        assert rz.verify_replicas(fixed, mesh=mesh42) == []
+        _tree_equal(fixed, state)  # rank 0 was clean: full state restored
+
+    def test_resync_passes_through_shared_leaves(self, mesh42):
+        state = _stacked_state(mesh42)
+        fixed = rz.resync_replicas(state, mesh=mesh42)
+        assert float(fixed["shared"]) == 1.5
+
+    def test_collapse_expand_roundtrip(self, mesh42):
+        state = _stacked_state(mesh42)
+        logical = rz.collapse_replicas(state)
+        assert np.shape(logical["w"]) == (8, 8)  # replica axis dropped
+        assert np.shape(logical["shared"]) == ()  # untouched
+        back = rz.expand_replicas(
+            {"w": logical["w"], "b": logical["b"]}, mesh42)
+        _tree_equal(back["w"], state["w"])
+        _tree_equal(back["b"], state["b"])
+
+    def test_policy_repairs_and_counts(self, mesh42, events):
+        cons = rz.ReplicaConsistency(mesh=mesh42)
+        state = _stacked_state(mesh42)
+        bad = rz.DesyncReplica([1], rank=1, leaf="w")(state, 1)
+        out = cons.check(bad, step=1)
+        assert cons.resyncs == 1
+        assert rz.verify_replicas(out, mesh=mesh42) == []
+        [ev] = events("replica_resync")
+        assert ev["leaves"] == ["['w']"] and ev["root"] == 0
+
+    def test_policy_raises_when_resync_disabled(self, mesh42):
+        cons = rz.ReplicaConsistency(mesh=mesh42, resync=False)
+        bad = rz.DesyncReplica([0], rank=1, leaf="w")(
+            _stacked_state(mesh42), 0)
+        with pytest.raises(rz.ReplicaDesyncError, match=r"\['w'\]") as e:
+            cons.check(bad, step=9)
+        assert e.value.step == 9
+        assert e.value.report[0].ranks == (1,)
+        assert e.value.transient is False  # retry layer must never retry
+
+    def test_policy_clean_state_is_identity(self, mesh42):
+        cons = rz.ReplicaConsistency(mesh=mesh42)
+        state = _stacked_state(mesh42)
+        assert cons.check(state, step=0) is state
+        assert cons.resyncs == 0
+
+    def test_rank0_fault_repaired_from_majority(self, mesh42, events):
+        """A fault on rank 0 itself must NOT be broadcast to the healthy
+        majority: the repair elects a majority-consistent root."""
+        state = _stacked_state(mesh42)
+        bad = rz.DesyncReplica([0], rank=0, leaf="w", delta=0.5)(state, 0)
+        out = rz.ReplicaConsistency(mesh=mesh42).check(bad, step=0)
+        assert rz.verify_replicas(out, mesh=mesh42) == []
+        _tree_equal(out, state)  # the majority's copy won, not rank 0's
+        [ev] = events("replica_resync")
+        assert ev["root"] != 0
+
+    def test_majority_root_tie_falls_back_to_default(self):
+        split = rz.DivergedLeaf(path="['x']", ranks=(1,),
+                                max_abs_delta=1.0, hashes=(7, 8))
+        assert rz.majority_root([split], default=0) == 0
+        clear = rz.DivergedLeaf(path="['y']", ranks=(1, 2, 3),
+                                max_abs_delta=1.0, hashes=(5, 9, 9, 9))
+        assert rz.majority_root([clear], default=0) == 1
+        # the elected root must be majority-consistent for EVERY leaf
+        assert rz.majority_root([clear, split], default=0) == 0
+
+    def test_collapse_handles_tuple_form_lead_entry(self, mesh42):
+        """P(('dp',), ...) is the same sharding as P('dp', ...): the
+        collapse must agree with what verify/resync call stacked."""
+        leaf = jax.device_put(
+            jnp.ones((4, 8), jnp.float32),
+            NamedSharding(mesh42, P(("dp",), "tp")))
+        out = rz.collapse_replicas({"w": leaf})
+        assert np.shape(out["w"]) == (8,)
+
+    def test_verify_handles_non_word_aligned_shards(self, mesh42):
+        """Local shard byte counts that are not a multiple of the hash's
+        u32 word size (bf16 x 3 = 6 bytes) still verify and localize."""
+        logical = {"v": jax.device_put(
+            jnp.arange(3, dtype=jnp.bfloat16),
+            NamedSharding(mesh42, P()))}
+        state = rz.expand_replicas(logical, mesh42)
+        assert rz.verify_replicas(state, mesh=mesh42) == []
+        bad = rz.DesyncReplica([0], rank=3, leaf="v", delta=1.0)(state, 0)
+        report = rz.verify_replicas(bad, mesh=mesh42)
+        assert [d.ranks for d in report] == [(3,)]
+
+    def test_desync_guarantees_byte_change_in_low_precision(self, mesh42):
+        """delta=1e-3 on bfloat16 values of magnitude 256 rounds to a
+        no-op; the injector must still produce a real divergence."""
+        logical = {"w": jax.device_put(
+            jnp.full((8, 8), 256.0, jnp.bfloat16),
+            NamedSharding(mesh42, P(None, "tp")))}
+        state = rz.expand_replicas(logical, mesh42)
+        bad = rz.DesyncReplica([0], rank=1, leaf="w", delta=1e-3)(state, 0)
+        report = rz.verify_replicas(bad, mesh=mesh42)
+        assert [d.ranks for d in report] == [(1,)]
+
+
+# --------------------------------------------------------------------------
+# supervisor wiring
+# --------------------------------------------------------------------------
+
+
+class _AlwaysDesynced:
+    """Stub consistency pass whose repair never converges."""
+
+    def __init__(self):
+        self.calls = []
+
+    def check(self, state, *, step):
+        self.calls.append(step)
+        raise rz.ReplicaDesyncError(step, [])
+
+
+class TestSupervisorConsistency:
+    def test_interval_runs_check_and_repairs(self, tmp_path, mesh42):
+        """The supervisor runs the consistency pass every K steps and
+        carries the repaired state forward."""
+        cons = rz.ReplicaConsistency(mesh=mesh42)
+        fault = rz.DesyncReplica([3], rank=2, leaf="w")
+        sup = rz.TrainingSupervisor(
+            None, rz.SupervisorConfig(step_deadline_s=300.0,
+                                      consistency_check_interval=2),
+            consistency=cons)
+
+        def step_fn(state, batch, step):
+            return fault(state, step)  # desync lands AFTER step 3
+
+        state = _stacked_state(mesh42)
+        final, last = sup.run(step_fn, state, iter(range(6)), num_steps=6)
+        assert last == 5
+        assert cons.resyncs == 1  # detected at the step-3 interval check
+        assert rz.verify_replicas(final, mesh=mesh42) == []
+        _tree_equal(final, state)  # rank 0 clean -> repair is exact
+
+    def test_unrepairable_desync_escalates(self, tmp_path, events):
+        """An unrepairable desync counts as an unrecovered failure and
+        escalates through emergency-checkpoint + TrainingAborted."""
+        mgr = rz.CheckpointManager(str(tmp_path))
+        stub = _AlwaysDesynced()
+        sup = rz.TrainingSupervisor(
+            mgr, rz.SupervisorConfig(step_deadline_s=300.0,
+                                     max_consecutive_failures=2,
+                                     consistency_check_interval=1),
+            consistency=stub)
+        state = {"x": jnp.float32(0)}
+        with pytest.raises(rz.TrainingAborted):
+            sup.run(lambda s, b, i: s, state, iter(range(9)), num_steps=9)
+        assert stub.calls == [0, 1]
+        fails = events("supervisor_failure")
+        assert [f["failure"] for f in fails] == ["ReplicaDesyncError"] * 2
+        [abort] = events("supervisor_abort")
+        assert abort["checkpoint"] is not None
+        rz.validate_checkpoint(abort["checkpoint"])
+
+    def test_persist_transform_saves_logical_form(self, tmp_path, devices,
+                                                  mesh42):
+        """With persist_transform=collapse_replicas, every checkpoint the
+        supervisor writes stores the mesh-shape-free logical copy — so
+        an elastic restart on a DIFFERENT dp world size restores it."""
+        root = str(tmp_path / "sup_elastic")
+        mgr = rz.ShardedCheckpointManager(root, mesh=mesh42)
+        sup = rz.TrainingSupervisor(
+            mgr, rz.SupervisorConfig(step_deadline_s=300.0,
+                                     consistency_check_interval=2),
+            consistency=rz.ReplicaConsistency(mesh=mesh42),
+            persist_transform=rz.collapse_replicas)
+        state = _stacked_state(mesh42)
+        final, last = sup.run(lambda s, b, i: s, state,
+                              iter(range(2)), num_steps=2)
+        with open(os.path.join(mgr.checkpoint_path(last),
+                               "manifest.json")) as f:
+            man = json.load(f)
+        by_path = {r["path"]: r for r in man["leaves"]}
+        assert by_path["['w']"]["shape"] == [8, 8]  # replica axis gone
+        mesh81 = _mesh(devices, 8, 1)
+        template = rz.collapse_replicas(_stacked_state(mesh81))
+        restored, step = rz.ShardedCheckpointManager(
+            root, mesh=mesh81).restore(like=template)
+        assert step == last
+        _tree_equal(restored, rz.collapse_replicas(final))
+
+    def test_desync_below_threshold_skips_periodic_commit(self, tmp_path):
+        """An unrepairable desync must never let the periodic commit
+        persist the untrusted state — a bit-rotted tree is internally
+        consistent, so it would pass CRC validation, become
+        latest_valid_step, and survive the restart."""
+        mgr = rz.CheckpointManager(str(tmp_path))
+        stub = _AlwaysDesynced()
+        sup = rz.TrainingSupervisor(
+            mgr, rz.SupervisorConfig(step_deadline_s=300.0,
+                                     max_consecutive_failures=5,
+                                     checkpoint_every=1,
+                                     consistency_check_interval=1),
+            consistency=stub)
+        sup.run(lambda s, b, i: s, {"x": jnp.float32(0)},
+                iter(range(3)), num_steps=3)
+        assert stub.calls == [0, 1, 2]
+        assert rz.latest_valid_step(str(tmp_path)) is None
+        assert os.listdir(tmp_path) == []
+
+    def test_standing_desync_escalates_across_intervals(self, tmp_path):
+        """With interval > 1, the successful steps BETWEEN failed checks
+        must neither reset the failure counter (the desync would never
+        escalate) nor re-earn commit trust (the periodic save would
+        persist the still-diverged state)."""
+        mgr = rz.CheckpointManager(str(tmp_path))
+        stub = _AlwaysDesynced()
+        sup = rz.TrainingSupervisor(
+            mgr, rz.SupervisorConfig(step_deadline_s=300.0,
+                                     max_consecutive_failures=2,
+                                     checkpoint_every=1,
+                                     consistency_check_interval=3),
+            consistency=stub)
+        with pytest.raises(rz.TrainingAborted):
+            sup.run(lambda s, b, i: s, {"x": jnp.float32(0)},
+                    iter(range(9)), num_steps=9)
+        assert stub.calls == [2, 5]  # escalated at the SECOND failure
+        steps = sorted(int(n[len("step_"):])
+                       for n in os.listdir(tmp_path)
+                       if n.startswith("step_"))
+        # steps 0-1 committed while trusted; 2-4 skipped (standing
+        # desync); 5 is the ladder's emergency checkpoint at abort
+        assert steps == [0, 1, 5]
+
+    def test_interval_zero_never_checks(self):
+        stub = _AlwaysDesynced()
+        sup = rz.TrainingSupervisor(
+            None, rz.SupervisorConfig(step_deadline_s=300.0),
+            consistency=stub)
+        sup.run(lambda s, b, i: s, {"x": 0}, iter(range(3)), num_steps=3)
+        assert stub.calls == []
+
+    def test_negative_interval_rejected(self):
+        with pytest.raises(ValueError, match="consistency_check_interval"):
+            rz.SupervisorConfig(consistency_check_interval=-1)
+
+
+# --------------------------------------------------------------------------
+# THE acceptance run (ISSUE 3)
+# --------------------------------------------------------------------------
+
+_H, _B, _LR = 8, 4, 2.0 ** -6
+_PSPECS = {"w": P("dp", None, "tp"), "b": P("dp", "tp")}
+
+
+def _init_train_state(mesh):
+    dp = int(mesh.shape["dp"])
+    w = (jnp.arange(_H * _H, dtype=jnp.float32).reshape(_H, _H)
+         % 5 - 2) / 8.0
+    b = (jnp.arange(_H, dtype=jnp.float32) % 3 - 1) / 4.0
+    return {"w": jax.device_put(jnp.broadcast_to(w, (dp, _H, _H)),
+                                NamedSharding(mesh, _PSPECS["w"])),
+            "b": jax.device_put(jnp.broadcast_to(b, (dp, _H)),
+                                NamedSharding(mesh, _PSPECS["b"]))}
+
+
+def _batch(i):
+    rng = np.random.default_rng(100 + i)
+    return jnp.asarray(rng.integers(-2, 3, size=(_B, _H)), jnp.float32)
+
+
+def _make_step(mesh):
+    """One dp x tp train step over the stacked per-replica state: every
+    dp rank computes grads on the (shared) batch, all-reduces them over
+    'dp' (exact for identical summands at power-of-2 dp), and applies a
+    plain SGD update to ITS OWN stacked copy — the representation a
+    replica fault can actually diverge."""
+
+    def body(params, x):
+        w, b = params["w"][0], params["b"][0]  # this replica's copy
+        y = x @ w + b                          # (B, H/tp) local columns
+        gy = 2.0 * y
+        gw = x.T @ gy
+        gb = gy.sum(0)
+        dpn = jax.lax.psum(1, "dp")
+        gw = jax.lax.psum(gw, "dp") / dpn      # the dp all-reduce
+        gb = jax.lax.psum(gb, "dp") / dpn
+        loss = jax.lax.psum(jnp.sum(y * y), ("dp", "tp")) / dpn
+        return ({"w": (w - _LR * gw)[None], "b": (b - _LR * gb)[None]},
+                loss)
+
+    return jax.jit(_shard_map(body, mesh=mesh, in_specs=(_PSPECS, P()),
+                              out_specs=(_PSPECS, P()), **_SHARD_MAP_KW))
+
+
+def _train(mesh, n_steps, *, state=None, start=0, fault=None,
+           consistency=None):
+    step_fn = _make_step(mesh)
+    if state is None:
+        state = _init_train_state(mesh)
+    losses = []
+    for i in range(start, start + n_steps):
+        state, loss = step_fn(state, _batch(i))
+        losses.append(float(loss))
+        if fault is not None:
+            state = fault(state, i)
+        if consistency is not None:
+            state = consistency.check(state, step=i)
+    return state, losses
+
+
+N1, N2, DESYNC_AT = 5, 4, 2
+
+
+def test_elastic_acceptance_run(tmp_path, devices, events):
+    """THE acceptance run (ISSUE 3): desync -> localize -> resync ->
+    trajectory matches clean; sharded save on (dp=4, tp=2) -> restart on
+    (dp=2, tp=4) and dp=8 bit-identically; shard corruption -> fallback
+    to the newest fully-valid checkpoint with a structured event."""
+    mesh42 = _mesh(devices, 4, 2)
+
+    # ---- clean reference on (dp=4, tp=2)
+    clean_state, clean_losses = _train(mesh42, N1 + N2)
+
+    # ---- faulted run: rank 1's w silently diverges after step DESYNC_AT;
+    # the per-step consistency pass detects, localizes, and resyncs it
+    cons = rz.ReplicaConsistency(mesh=mesh42)
+    fault = rz.DesyncReplica([DESYNC_AT], rank=1, leaf="w", delta=0.5)
+    state, losses = _train(mesh42, N1, fault=fault, consistency=cons)
+
+    assert cons.resyncs == 1
+    [desync] = events("replica_desync")
+    assert desync["leaf"] == "['w']" and desync["ranks"] == [1]
+    assert desync["step"] == DESYNC_AT
+    assert desync["max_abs_delta"] == pytest.approx(0.5, rel=1e-5)
+    # the repair is exact (rank 0 was clean), so the trajectory matches
+    # the clean run bit for bit
+    assert losses == clean_losses[:N1]
+    assert rz.verify_replicas(state, mesh=mesh42) == []
+
+    # ---- sharded save at step N1-1 on (dp=4, tp=2); the persisted form
+    # is the mesh-shape-free logical copy
+    root = str(tmp_path / "elastic")
+    mgr = rz.ShardedCheckpointManager(root, keep=3, mesh=mesh42)
+    mgr.save(N1 - 1, rz.collapse_replicas(state))
+
+    # ---- restart on (dp=2, tp=4) AND dp=8: bit-identical restore,
+    # then the run continues
+    for dp, tp in ((2, 4), (8, 1)):
+        mesh = _mesh(devices, dp, tp)
+        template = rz.collapse_replicas(_init_train_state(mesh))
+        logical, resume = rz.ShardedCheckpointManager(
+            root, mesh=mesh).restore(like=template)
+        assert resume == N1 - 1
+        # bit-identical resume: the restored logical state equals the
+        # saved one exactly, resharded onto the NEW mesh
+        _tree_equal(logical, rz.collapse_replicas(state))
+        assert logical["w"].sharding.mesh.shape == dict(mesh.shape)
+
+        restacked = rz.expand_replicas(logical, mesh)
+        assert rz.verify_replicas(restacked, mesh=mesh) == []
+        final, resumed_losses = _train(mesh, N2, state=restacked,
+                                       start=resume + 1)
+        # the continued trajectory tracks the uninterrupted clean run
+        # (identical math; XLA tiling differs across tp widths, so the
+        # comparison is tight-tolerance, not bit-exact)
+        np.testing.assert_allclose(resumed_losses, clean_losses[N1:],
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            _host(rz.collapse_replicas(final)["w"]),
+            _host(rz.collapse_replicas(clean_state)["w"]),
+            rtol=1e-5, atol=1e-8)
+
+    # ---- corrupt ONE shard of the newest checkpoint: restore falls
+    # back to the previous fully-valid step with a structured event
+    mgr.save(N1, rz.collapse_replicas(clean_state))
+    assert mgr.latest_valid_step() == N1
+    dmg = rz.CorruptShardFile(leaf="w", seed=7)(mgr.checkpoint_path(N1))
+    assert dmg["leaf"] == "['w']"
+    assert mgr.latest_valid_step() == N1 - 1
+    template = rz.collapse_replicas(_init_train_state(mesh42))
+    logical, step = mgr.restore(like=template)
+    assert step == N1 - 1
+    _tree_equal(logical, rz.collapse_replicas(state))
+    rejected = [e for e in events("checkpoint_rejected")
+                if e["step"] == N1]
+    assert rejected and "CRC mismatch" in rejected[0]["reason"]

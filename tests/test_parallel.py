@@ -11,7 +11,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+try:  # jax >= 0.6 exports it at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.contrib.clip_grad import clip_grad_norm
@@ -75,6 +78,36 @@ def test_reducer(mesh8):
     out = shard_map(lambda t: r.reduce(t), mesh=mesh8,
                     in_specs=(P("dp"),), out_specs=P("dp"))(p)
     np.testing.assert_allclose(np.asarray(out["w"]), 3.5)
+
+
+def test_broadcast_params_nonzero_root(mesh8):
+    p = {"w": jnp.arange(8, dtype=jnp.float32).reshape(8, 1)}
+    out = shard_map(lambda t: broadcast_params(t, "dp", root=3),
+                    mesh=mesh8, in_specs=(P("dp"),), out_specs=P("dp"))(p)
+    np.testing.assert_allclose(np.asarray(out["w"]), 3.0)
+
+
+def test_broadcast_params_rejects_out_of_range_root(mesh8):
+    """ISSUE 3 satellite: an out-of-range root would mask out EVERY rank
+    and silently broadcast zeros — validated eagerly instead."""
+    p = {"w": jnp.arange(8, dtype=jnp.float32).reshape(8, 1)}
+    for root in (8, -1):
+        with pytest.raises(ValueError, match="outside axis 'dp' of size 8"):
+            shard_map(lambda t: broadcast_params(t, "dp", root=root),
+                      mesh=mesh8, in_specs=(P("dp"),),
+                      out_specs=P("dp"))(p)
+
+
+def test_broadcast_params_unbound_axis_is_diagnosable():
+    """Called outside shard_map/pmap: a RuntimeError naming the axis and
+    the fix, not a raw JAX NameError from the internals."""
+    with pytest.raises(RuntimeError, match="axis 'dp' is not bound"):
+        broadcast_params({"w": jnp.ones((4,))}, "dp")
+
+
+def test_reducer_unbound_axis_is_diagnosable():
+    with pytest.raises(RuntimeError, match="axis 'dp' is not bound"):
+        Reducer("dp").reduce({"w": jnp.ones((4,))})
 
 
 def test_ddp_pjit_style_end_to_end(mesh8):

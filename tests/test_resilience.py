@@ -190,6 +190,34 @@ class TestCheckpoint:
         with pytest.raises(rz.CheckpointError, match="template does not"):
             mgr.restore(like={"w": jnp.ones((3,))}, step=0)
 
+    def test_structural_mismatches_name_offending_keystr(self, tmp_path):
+        """ISSUE 3 satellite: every restore_checkpoint structural-
+        mismatch path — wrong leaf shape, wrong dtype, missing leaf,
+        extra leaf — raises CheckpointError NAMING the offending keystr
+        (an operator fixing a template needs the leaf, not a diff)."""
+        rz.save_checkpoint(str(tmp_path), 0,
+                           {"w": jnp.ones((3, 2), jnp.float32),
+                            "b": jnp.zeros((4,), jnp.float32)})
+        good_b = jnp.zeros((4,), jnp.float32)
+        with pytest.raises(rz.CheckpointError,
+                           match=r"\['w'\].*template wants float32\[3, 3\]"):
+            rz.restore_checkpoint(
+                str(tmp_path), {"w": jnp.ones((3, 3), jnp.float32),
+                                "b": good_b}, step=0)
+        with pytest.raises(rz.CheckpointError,
+                           match=r"\['w'\].*template wants bfloat16"):
+            rz.restore_checkpoint(
+                str(tmp_path), {"w": jnp.ones((3, 2), jnp.bfloat16),
+                                "b": good_b}, step=0)
+        with pytest.raises(rz.CheckpointError,
+                           match=r"no leaf \"\['v'\]\""):
+            rz.restore_checkpoint(
+                str(tmp_path), {"v": jnp.ones((3, 2), jnp.float32),
+                                "b": good_b}, step=0)
+        with pytest.raises(rz.CheckpointError,
+                           match=r"template does not.*\['w'\]"):
+            rz.restore_checkpoint(str(tmp_path), {"b": good_b}, step=0)
+
     def test_pinned_step_restore(self, tmp_path):
         mgr = rz.CheckpointManager(str(tmp_path), keep=5)
         for s in range(3):
@@ -265,6 +293,75 @@ class TestCheckpoint:
 # --------------------------------------------------------------------------
 # fault injection
 # --------------------------------------------------------------------------
+
+class TestManifestMeshMetadata:
+    """ISSUE 3 satellite: every manifest stamps format_version plus the
+    saving mesh's shape/world sizes, and a v1 (whole-tree) checkpoint
+    refuses to restore onto a DIFFERENT mesh instead of silently
+    resharding wrong.  Pre-ISSUE-3 manifests (no mesh key) still load."""
+
+    def test_manifest_stamps_version_and_mesh(self, tmp_path, devices):
+        from apex_tpu.transformer import parallel_state
+
+        parallel_state.initialize_model_parallel(2, devices=devices[:8])
+        try:
+            path = rz.save_checkpoint(str(tmp_path), 0, {"w": jnp.ones(3)})
+        finally:
+            parallel_state.destroy_model_parallel()
+        with open(os.path.join(path, "manifest.json")) as f:
+            man = json.load(f)
+        assert man["format_version"] == 1
+        assert man["mesh"]["axes"] == {"dp": 4, "pp": 1, "tp": 2}
+        assert (man["mesh"]["dp"], man["mesh"]["pp"],
+                man["mesh"]["tp"]) == (4, 1, 2)
+        assert man["mesh"]["world"] == 8
+
+    def test_v1_mismatched_mesh_restore_raises(self, tmp_path, devices):
+        from apex_tpu.transformer import parallel_state
+
+        parallel_state.initialize_model_parallel(2, devices=devices[:8])
+        try:
+            rz.save_checkpoint(str(tmp_path), 0, {"w": jnp.ones(3)})
+        finally:
+            parallel_state.destroy_model_parallel()
+        # restart lands on a (dp=2, tp=4) slice: the whole-tree bytes
+        # cannot reshard, so the restore must refuse loudly
+        parallel_state.initialize_model_parallel(4, devices=devices[:8])
+        try:
+            with pytest.raises(rz.CheckpointError, match="cannot reshard"):
+                rz.restore_checkpoint(str(tmp_path), {"w": jnp.ones(3)},
+                                      step=0)
+        finally:
+            parallel_state.destroy_model_parallel()
+        # back on the saving shape, the same checkpoint loads fine
+        parallel_state.initialize_model_parallel(2, devices=devices[:8])
+        try:
+            _, step = rz.restore_checkpoint(str(tmp_path),
+                                            {"w": jnp.ones(3)})
+        finally:
+            parallel_state.destroy_model_parallel()
+        assert step == 0
+
+    def test_legacy_manifest_without_mesh_still_loads(self, tmp_path,
+                                                      devices):
+        from apex_tpu.transformer import parallel_state
+
+        path = rz.save_checkpoint(str(tmp_path), 0, {"w": jnp.ones(3)})
+        mp = os.path.join(path, "manifest.json")
+        with open(mp) as f:
+            man = json.load(f)
+        assert man["mesh"] is None  # no parallel_state at save time
+        del man["mesh"]  # a pre-ISSUE-3 v1 manifest has no mesh key
+        with open(mp, "w") as f:
+            json.dump(man, f)
+        parallel_state.initialize_model_parallel(2, devices=devices[:8])
+        try:
+            _, step = rz.restore_checkpoint(str(tmp_path),
+                                            {"w": jnp.ones(3)})
+        finally:
+            parallel_state.destroy_model_parallel()
+        assert step == 0
+
 
 class TestFaultInjection:
     def test_grad_injection_is_step_targeted(self):

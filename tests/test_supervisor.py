@@ -389,6 +389,27 @@ class TestWatchdog:
         wd.beat(199, ckpt_path="/ckpts/step_199")
         assert rz.read_heartbeat(hb)["ckpt_path"] == "/ckpts/step_199"
 
+    def test_heartbeat_carries_rank_info_when_model_parallel(
+            self, tmp_path, devices):
+        """ISSUE 3 satellite: with model parallelism initialized, the
+        heartbeat names WHICH slice member wrote it (rank descriptor +
+        machine-readable mesh shape); without it, neither key appears."""
+        from apex_tpu.transformer import parallel_state
+
+        hb = str(tmp_path / "hb.json")
+        rz.write_heartbeat(hb, 1)
+        got = rz.read_heartbeat(hb)
+        assert "rank_info" not in got and "mesh" not in got
+
+        parallel_state.initialize_model_parallel(2, devices=devices[:8])
+        try:
+            rz.write_heartbeat(hb, 2)
+        finally:
+            parallel_state.destroy_model_parallel()
+        got = rz.read_heartbeat(hb)
+        assert got["mesh"] == {"dp": 4, "pp": 1, "tp": 2}
+        assert "dp=4" in got["rank_info"] and "tp=2" in got["rank_info"]
+
 
 # --------------------------------------------------------------------------
 # data-pipeline guard

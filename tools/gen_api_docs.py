@@ -79,6 +79,8 @@ PAGES = {
     ]),
     "resilience": ("Training resilience", [
         "apex_tpu.resilience", "apex_tpu.resilience.checkpoint",
+        "apex_tpu.resilience.elastic",
+        "apex_tpu.resilience.consistency",
         "apex_tpu.resilience.fault_injection",
         "apex_tpu.resilience.guarded",
         "apex_tpu.resilience.supervisor",
@@ -289,6 +291,62 @@ injectors (`SlowStep`, `FlakyIterator`, `CorruptBatch`) drive every one
 of these paths under tier-1 on CPU, including a full
 flaky-fetch + corrupt-batch + slow-step → abort → bit-identical-resume
 acceptance run.
+
+## Elastic restart (sharded checkpoints, manifest v2)
+
+A v1 checkpoint is one whole-tree byte stream and can only restore onto
+the mesh shape that wrote it (mismatched-mesh restore of a v1 file
+raises `CheckpointError` — every manifest now stamps the saving mesh's
+shape and dp/tp/pp world sizes, so the guard is exact).  A *sharded*
+checkpoint (`save_sharded_checkpoint` / `ShardedCheckpointManager`,
+`format_version: 2`) is mesh-shape-agnostic: each leaf is cut into the
+shard grid its `PartitionSpec` implies, and every shard gets its own
+manifest record:
+
+```
+<root>/step_0000000042/manifest.json
+  format_version: 2, sharded: true, step, data_nbytes,
+  mesh: {axes: {dp: 4, pp: 1, tp: 2}, axis_names, world, dp, tp, pp},
+  leaves: [{path, shape,            # GLOBAL shape
+            dtype, prng_key, spec,  # per-dim partitioning axis names
+            shards: [{coords,       # {axis: coordinate} on the saving mesh
+                      index,        # [[start, stop], ...] per array dim
+                      offset, nbytes, crc32}, ...]}, ...]
+<root>/step_0000000042/data.bin     # concatenated shard bytes
+```
+
+Restore (`restore_sharded_checkpoint(root, like)`) reassembles each
+global leaf shard-by-shard (seek + read + per-shard CRC, placed by the
+recorded `index`) and re-shards it onto the **template's** sharding —
+which may live on a completely different mesh shape.  Saving on
+`(dp=4, tp=2)` and resuming on `(dp=2, tp=4)` or `dp=8` is bit-identical
+by construction: the bytes never pass through arithmetic.  One flipped
+byte (`CorruptShardFile`) is localized to one shard of one leaf by its
+CRC, and the newest-valid fallback walk skips the damaged step with a
+`checkpoint_rejected` event.  A root may mix v1 and v2 directories; dim
+sizes must divide evenly by their partitioning axes (uneven/padded
+shards have no stable byte layout to reshard from).
+
+## Cross-replica consistency
+
+Data-parallel replicas are supposed to be bit-identical; at pod scale
+the invariant silently breaks (HBM bit flips, a stale host update), and
+every later all-reduce averages the corruption into the whole pod.  The
+checkable representation is *stacked* per-replica state — each leaf
+carries a leading replica axis sharded over `dp` (`expand_replicas` /
+`collapse_replicas` convert to and from the logical single-copy form,
+which is what elastic checkpoints persist).  `verify_replicas` hashes
+every leaf per replica inside `shard_map` (only a u32 digest and an f32
+delta per (leaf, replica) cross the wire) and localizes each diverged
+leaf — keystr path, diverged ranks, max-abs delta — via structured
+`replica_desync` events; `resync_replicas` repairs in place by
+re-broadcasting rank 0's copy.  `ReplicaConsistency` packages
+verify → resync → re-verify as the policy object
+`TrainingSupervisor(..., consistency=...,
+SupervisorConfig(consistency_check_interval=K))` runs every K steps,
+*before* the periodic checkpoint commit (a desynced state is never
+persisted); an unrepairable desync (`ReplicaDesyncError`) counts as one
+unrecovered failure in the same escalation ladder as every other fault.
 """,
 }
 
@@ -469,6 +527,44 @@ step is reported mid-stall by the watchdog's monitor thread (structured
 can kill and requeue with evidence.  Every path above is driven
 deterministically in tier-1 by the fault injectors (`SlowStep`,
 `FlakyIterator`, `CorruptBatch`).
+
+Resize the pod mid-training — a preempted job rarely gets the same slice
+back.  *Sharded* checkpoints (manifest v2) record one CRC'd shard per
+(leaf, mesh-coordinate block) and reshard on restore onto whatever mesh
+the templates live on, bit-identically; periodic `verify_replicas`
+catches silent dp divergence before it spreads
+([full page](api/resilience.md)):
+
+```python
+from apex_tpu import resilience as rz
+from apex_tpu.transformer import parallel_state
+
+# ---- before the resize: train on (dp=4, tp=2), save SHARDED
+mesh = parallel_state.initialize_model_parallel(2)       # dp=4, tp=2
+mgr = rz.ShardedCheckpointManager("/ckpts/run7", keep=3,
+                                  mesh=mesh, retry=rz.RetryPolicy())
+sup = rz.TrainingSupervisor(
+    mgr, rz.SupervisorConfig(consistency_check_interval=50),
+    consistency=rz.ReplicaConsistency(mesh=mesh),        # verify+resync
+    persist_transform=rz.collapse_replicas)  # EVERY checkpoint the
+    # supervisor writes (periodic and emergency) stores the mesh-shape-
+    # free logical copy, never the dp-world-size-dependent stacked form
+logical = rz.collapse_replicas(state)                    # mesh-shape-free
+mgr.save(step, logical)                                  # per-shard CRCs
+
+# ---- after the resize: SAME root, different slice (dp=2, tp=4)
+mesh = parallel_state.initialize_model_parallel(4)       # dp=2, tp=4
+template = init_state(mesh)          # leaves carry the NEW shardings
+logical, last = mgr.restore(like=rz.collapse_replicas(template))
+state = rz.expand_replicas(logical, mesh)  # re-stack at the new dp size
+```
+
+The restore walk validates per-shard CRCs as it reassembles each global
+leaf, falls back past a damaged step (`checkpoint_rejected` event), and
+never runs arithmetic on the bytes — resuming on `(dp=2, tp=4)` or
+`dp=8` is bit-identical to the `(dp=4, tp=2)` save.  A **v1**
+(whole-tree) checkpoint cannot reshard: restoring one onto a different
+mesh raises `CheckpointError` instead of silently resharding wrong.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -31,7 +31,8 @@ __all__ = ["HaloExchanger1d", "halo_exchange_1d", "left_right_halo_exchange",
 
 
 def _axis_size(axis_name: str) -> int:
-    return jax.lax.axis_size(axis_name)
+    # psum of a literal is evaluated statically; jax 0.4.x has no axis_size
+    return jax.lax.psum(1, axis_name)
 
 
 def left_right_halo_exchange(left_output_halo, right_output_halo,

@@ -69,3 +69,5 @@ register("resilience", "validated checkpointing + fault injection + guarded step
          False, "host I/O + jnp")
 register("supervisor", "step watchdog + heartbeat + transient retry + data guard + escalation",
          False, "host threads + I/O")
+register("serving", "slotted KV-cache decode + continuous batching + checkpoint serving",
+         False, "jnp/XLA + host scheduler")

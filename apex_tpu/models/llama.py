@@ -81,10 +81,75 @@ class LlamaConfig:
 
 
 def _rope_freqs(s: int, dim: int, theta: float, offset=0) -> jax.Array:
+    """Rotary frequencies for ``s`` positions starting at ``offset``.
+
+    A scalar ``offset`` (the training path, and single-stream decode)
+    yields ``[s, 1, 1, d]``.  A vector ``offset`` of shape ``[b]`` — one
+    start position per batch element, the batched-decode case where
+    every KV-cache slot sits at its own depth — yields ``[s, b, 1, d]``,
+    which broadcasts against ``[s, b, h, d]`` activations identically.
+    Position ``p``'s row is ``p * inv`` in both forms, so decoding token
+    ``p`` through the vector path is bit-identical to the full-sequence
+    training freqs at row ``p``.
+    """
     inv = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    if isinstance(offset, jax.Array) and offset.ndim:
+        t = (jnp.arange(s, dtype=jnp.float32)[:, None]
+             + offset.astype(jnp.float32)[None, :])        # [s, b]
+        f = t[..., None] * inv
+        return jnp.concatenate([f, f], axis=-1)[:, :, None, :]  # [s,b,1,d]
     t = jnp.arange(s, dtype=jnp.float32) + offset
     f = jnp.outer(t, inv)
     return jnp.concatenate([f, f], axis=-1)[:, None, None, :]  # [s,1,1,d]
+
+
+# single-token decode pads its query block to this many (identical) rows:
+# XLA-CPU lowers an M=1 score "matmul" as a gemv whose per-element rounding
+# differs from the gemm the uncached forward's [s, s] scores go through;
+# M=8 keeps both paths in the gemm regime so the dot products round
+# identically (pinned by tests/test_serving.py bit-parity)
+_DECODE_QPAD = 8
+
+
+def _decode_attention(qt, kt, vt, position):
+    """Length-masked attention read over a full KV-cache buffer.
+
+    ``qt``: ``[b, h, 1, hd]`` (the current token per slot); ``kt``/``vt``:
+    ``[b, h, max_len, hd]`` (the cache, GQA-expanded); ``position``:
+    ``[b]`` — index of each slot's current token (``idx <= position`` is
+    visible, everything past it is masked garbage).
+
+    The op sequence mirrors ``ops.flash_attention.mha_reference`` (scale
+    folded into fp32 q before the dot, ``-1e30`` mask, max/exp/sum/divide,
+    fp32 PV, cast back) so that against an uncached forward **run at the
+    same static ``max_len`` extent** every reduction sees identical
+    operand extents — masked tails are exact zeros — and the result is
+    bit-identical, per step, forever (the no-recompile serving contract
+    and the parity acceptance test in one property).
+    """
+    from apex_tpu.ops.flash_attention import _NEG_INF
+    from apex_tpu.serving.kv_cache import valid_token_mask
+
+    b, h, _, hd = qt.shape
+    max_len = kt.shape[2]
+    scale = 1.0 / hd ** 0.5
+    qp = jnp.broadcast_to(qt, (b, h, _DECODE_QPAD, hd))
+    s = jax.lax.dot_general(
+        qp.astype(jnp.float32) * scale, kt.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1))))          # [b, h, QPAD, max]
+    # masked scores sit at the flash kernels' exact _NEG_INF: exp of the
+    # masked residual underflows to exactly 0.0 in f32, which is what
+    # makes these fixed-extent reductions bit-exact vs a same-extent
+    # uncached forward
+    valid = valid_token_mask(position, max_len)
+    s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / l
+    out = jax.lax.dot_general(p, vt.astype(jnp.float32),
+                              (((3,), (2,)), ((0, 1), (0, 1))))
+    return out[:, :, :1].astype(qt.dtype)           # [b, h, 1, hd]
 
 
 class LlamaMLP(nn.Module):
@@ -128,7 +193,28 @@ class LlamaAttention(nn.Module):
 
     @nn.compact
     @jax.named_scope("llama_attention")
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, *, kv_cache=None,
+                 layer_idx: Optional[int] = None, position=None, slot=None):
+        """Causal self-attention; optionally reading/writing a KV cache.
+
+        Without ``kv_cache`` this is the training path, unchanged.  With
+        one (see :mod:`apex_tpu.serving.kv_cache`), two serving modes:
+
+        - **prefill** (``s > 1``, ``position=None``): the attention
+          itself is the exact training computation over the prompt; the
+          per-token K/V are additionally written into ``kv_cache`` at
+          ``(layer_idx, slot, 0..s)``, so prefill logits are
+          bit-identical to the plain forward by construction.  (Offset
+          prefill is rejected: a chunk's causal attention cannot see
+          earlier cached tokens.)
+        - **decode** (``s == 1``): ``position`` is a ``[b]`` vector of
+          per-slot depths; rope is applied at the true position, the new
+          K/V are appended at ``position``, and attention reads the full
+          ``max_len`` cache under a length mask — one static shape for
+          every decode step (no recompiles after warmup).
+
+        Returns ``out`` (training) or ``(out, kv_cache)`` (serving).
+        """
         cfg = self.config
         world = tp_world_size(self.axis_name)
         hd = cfg.hidden_size // cfg.num_attention_heads
@@ -149,27 +235,75 @@ class LlamaAttention(nn.Module):
         k = k.reshape(s, b, nkv, hd)
         v = v.reshape(s, b, nkv, hd)
 
-        freqs = _rope_freqs(s, hd, cfg.rope_theta)
+        decode = kv_cache is not None and s == 1
+        if kv_cache is not None and not decode and position is not None:
+            # offset ("chunked") prefill is NOT supported: a prefill
+            # chunk's causal attention sees only itself, so its hidden
+            # states — and the K/V cached from them at layers >= 1 —
+            # would silently miss every earlier cached token.  Refuse
+            # loudly instead of caching wrong keys.
+            raise ValueError(
+                "prefill always starts a slot at position 0 (pass "
+                "position=None); continuing a stream is what decode "
+                "steps are for")
+        if decode:
+            # rope at each slot's true depth ([b]-vector offset)
+            freqs = _rope_freqs(s, hd, cfg.rope_theta,
+                                offset=jnp.asarray(position))
+        else:
+            freqs = _rope_freqs(s, hd, cfg.rope_theta)
         q = fused_apply_rotary_pos_emb(q, freqs)
         k = fused_apply_rotary_pos_emb(k, freqs)
 
-        # GQA: each kv head serves nq/nkv query heads
-        if nkv != nq:
-            rep = nq // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        if kv_cache is not None:
+            from apex_tpu.serving import kv_cache as kvc
 
-        qt = q.transpose(1, 2, 0, 3)     # [b, nq, s, hd]
-        kt = k.transpose(1, 2, 0, 3)
-        vt = v.transpose(1, 2, 0, 3)
-        ctx = flash_attention(qt, kt, vt, causal=True)
+            if decode:
+                # append this token per slot, then attend over the whole
+                # masked cache (post-rope K, like the uncached path sees)
+                kv_cache = kvc.append_token(
+                    kv_cache, layer_idx, k[0], v[0],
+                    jnp.asarray(position))
+                kc = kv_cache.k[layer_idx].astype(q.dtype)  # [b,max,nkv,hd]
+                vc = kv_cache.v[layer_idx].astype(q.dtype)
+                if nkv != nq:
+                    rep = nq // nkv
+                    kc = jnp.repeat(kc, rep, axis=2)
+                    vc = jnp.repeat(vc, rep, axis=2)
+                qt = q.transpose(1, 2, 0, 3)        # [b, nq, 1, hd]
+                kt = kc.transpose(0, 2, 1, 3)       # [b, nq, max, hd]
+                vt = vc.transpose(0, 2, 1, 3)
+                ctx = _decode_attention(qt, kt, vt, position)
+            else:
+                # prefill: training-exact attention over the prompt; the
+                # cache write is purely additive
+                if b != 1:
+                    raise ValueError(
+                        f"prefill expects one slot per call (b=1), got "
+                        f"b={b}")
+                kv_cache = kvc.prefill_into_slot(
+                    kv_cache, layer_idx, slot, k[:, 0], v[:, 0])
+        if not decode:
+            # GQA: each kv head serves nq/nkv query heads
+            if nkv != nq:
+                rep = nq // nkv
+                k = jnp.repeat(k, rep, axis=2)
+                v = jnp.repeat(v, rep, axis=2)
+
+            qt = q.transpose(1, 2, 0, 3)     # [b, nq, s, hd]
+            kt = k.transpose(1, 2, 0, 3)
+            vt = v.transpose(1, 2, 0, 3)
+            ctx = flash_attention(qt, kt, vt, causal=True)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, nq * hd)
-        return RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
-                                 input_is_parallel=True,
-                                 sequence_parallel_enabled=self.sequence_parallel_enabled,
-                                 params_dtype=self.params_dtype,
-                                 axis_name=self.axis_name, use_bias=False,
-                                 name="o_proj")(ctx)
+        out = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                input_is_parallel=True,
+                                sequence_parallel_enabled=self.sequence_parallel_enabled,
+                                params_dtype=self.params_dtype,
+                                axis_name=self.axis_name, use_bias=False,
+                                name="o_proj")(ctx)
+        if kv_cache is not None:
+            return out, kv_cache
+        return out
 
 
 class LlamaDecoderLayer(nn.Module):
@@ -179,22 +313,33 @@ class LlamaDecoderLayer(nn.Module):
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
-    def __call__(self, x, deterministic: bool = True):
+    def __call__(self, x, deterministic: bool = True, *, kv_cache=None,
+                 layer_idx: Optional[int] = None, position=None, slot=None):
         cfg = self.config
         h = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
                          param_dtype=self.params_dtype,
                          name="input_layernorm")(x)
-        x = x + LlamaAttention(
+        attn = LlamaAttention(
             cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
-            name="self_attn")(h, deterministic)
+            name="self_attn")
+        if kv_cache is not None:
+            a, kv_cache = attn(h, deterministic, kv_cache=kv_cache,
+                               layer_idx=layer_idx, position=position,
+                               slot=slot)
+        else:
+            a = attn(h, deterministic)
+        x = x + a
         h = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
                          param_dtype=self.params_dtype,
                          name="post_attention_layernorm")(x)
-        return x + LlamaMLP(
+        out = x + LlamaMLP(
             cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
             params_dtype=self.params_dtype, axis_name=self.axis_name,
             name="mlp")(h)
+        if kv_cache is not None:
+            return out, kv_cache
+        return out
 
 
 class LlamaForCausalLM(nn.Module):
@@ -211,8 +356,22 @@ class LlamaForCausalLM(nn.Module):
     axis_name: str = TENSOR_PARALLEL_AXIS
 
     @nn.compact
-    def __call__(self, input_ids, labels=None, deterministic: bool = True):
+    def __call__(self, input_ids, labels=None, deterministic: bool = True,
+                 *, kv_cache=None, position=None, slot=None):
+        """Forward pass; optionally in KV-cached serving mode.
+
+        With ``kv_cache`` (a :class:`apex_tpu.serving.kv_cache.KVCache`)
+        the call returns ``(logits, kv_cache)`` instead of logits/loss:
+        ``input_ids [1, s>1]`` + ``slot`` prefills one slot, ``input_ids
+        [slots, 1]`` + ``position [slots]`` runs one batched decode step
+        (see :class:`apex_tpu.serving.engine.DecodeEngine`).  ``labels``
+        is a training-only argument and rejected in serving mode.  The
+        default (``kv_cache=None``) path is unchanged.
+        """
         cfg = self.config
+        if kv_cache is not None and labels is not None:
+            raise ValueError("kv_cache is a serving-mode argument; "
+                             "labels is training-only")
         x = VocabParallelEmbedding(
             cfg.vocab_size, cfg.hidden_size, params_dtype=self.params_dtype,
             axis_name=self.axis_name, name="embed_tokens")(input_ids)
@@ -224,13 +383,23 @@ class LlamaForCausalLM(nn.Module):
 
             x = scatter_to_sequence_parallel_region(x, self.axis_name)
 
+        # serving always uses the plain layer: activation recompute is a
+        # training-memory lever (nothing to recompute at inference), and
+        # remat's static_argnums contract doesn't cover the cache kwargs
         layer_cls = (nn.remat(LlamaDecoderLayer, static_argnums=(2,))
-                     if self.activations_checkpoint else LlamaDecoderLayer)
+                     if self.activations_checkpoint and kv_cache is None
+                     else LlamaDecoderLayer)
         for i in range(cfg.num_hidden_layers):
-            x = layer_cls(
+            layer = layer_cls(
                 cfg, sequence_parallel_enabled=self.sequence_parallel_enabled,
                 params_dtype=self.params_dtype, axis_name=self.axis_name,
-                name=f"layers_{i}")(x, deterministic)
+                name=f"layers_{i}")
+            if kv_cache is not None:
+                x, kv_cache = layer(x, deterministic, kv_cache=kv_cache,
+                                    layer_idx=i, position=position,
+                                    slot=slot)
+            else:
+                x = layer(x, deterministic)
         x = FusedRMSNorm((cfg.hidden_size,), eps=cfg.rms_norm_eps,
                          param_dtype=self.params_dtype, name="norm")(x)
 
@@ -253,6 +422,8 @@ class LlamaForCausalLM(nn.Module):
         logits = parallel_lm_logits(
             x, head.astype(x.dtype), self.axis_name,
             sequence_parallel_enabled=self.sequence_parallel_enabled)
+        if kv_cache is not None:
+            return logits, kv_cache
         if labels is None:
             return logits
         return vocab_parallel_cross_entropy(

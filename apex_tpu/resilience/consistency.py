@@ -43,7 +43,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import inspect
 import time
 from typing import Any, Optional, Sequence
 
@@ -52,19 +51,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.6 exports it at top level (and renames check_rep)
-    from jax import shard_map as _shard_map
-except ImportError:  # jax 0.4.x
-    from jax.experimental.shard_map import shard_map as _shard_map
-
-# replication checking is named check_vma (new) / check_rep (0.4.x);
-# disable it either way — the hash pass mixes per-leaf specs and its
-# outputs are made replicated by explicit psum/all_gather, which older
-# rep-checkers reject conservatively
-_SHARD_MAP_KW = (
-    {"check_vma": False}
-    if "check_vma" in inspect.signature(_shard_map).parameters
-    else {"check_rep": False})
+# import-name and kwarg-name drift across jax versions is centralized
+# in utils.compat (probed once); the hash pass disables the replication
+# checker because it mixes per-leaf specs and makes outputs replicated
+# by explicit psum/all_gather, which older rep-checkers reject
+from apex_tpu.utils.compat import NO_REP_CHECK as _SHARD_MAP_KW
+from apex_tpu.utils.compat import shard_map as _shard_map
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.parallel.distributed import broadcast_params

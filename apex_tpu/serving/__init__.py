@@ -1,0 +1,83 @@
+"""apex_tpu.serving — KV-cached decode + continuous batching.
+
+The ROADMAP's north star serves heavy traffic; this subsystem is the
+inference-side counterpart of the training stack, reusing its kernels
+(flash attention's masked read path, the rope offset machinery, the LM
+head matmul), its amp policies, and its resilience checkpoints:
+
+- :mod:`.kv_cache` — preallocated slot-indexed decode cache
+  (``[layers, slots, max_len, kv_heads, head_dim]``) with per-slot
+  lengths and pure ``lax.dynamic_update_slice`` updates: one static
+  shape for every decode step, zero recompiles after warmup.
+- :mod:`.engine` — :class:`DecodeEngine`: a jitted prefill (full-prompt
+  forward that also fills a slot) + a jitted batched single-token decode
+  step, with deterministic greedy/temperature/top-k sampling from
+  explicit PRNG keys.  Cached incremental decode is bit-identical to
+  the uncached full-context forward (the tier-1 acceptance test).
+- :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: bounded
+  FIFO queue, slot admission at step boundaries, QUEUED → PREFILL →
+  DECODE → DONE per-request state machine, EOS/max-token eviction with
+  immediate slot reuse, and structured telemetry (queue depth, TTFT,
+  per-token latency, tokens/s) via ``emit_event``.
+- :mod:`.weights` — :func:`load_serving_params`: newest *valid* step
+  from a resilience checkpoint root (v1 whole-tree and v2 sharded both
+  work), params subtree selection, and bf16 serving casts through
+  ``amp.policy``.
+
+End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
+
+    from apex_tpu import serving as sv
+    from apex_tpu import amp
+
+    params, step = sv.load_serving_params(
+        "/ckpts/run7", like=train_state_template, params_key="params",
+        policy=amp.policy.O2())
+    eng = sv.DecodeEngine(model, params, slots=8, max_len=2048,
+                          prefill_len=256)
+    sched = sv.ContinuousBatchingScheduler(eng, max_queue=64)
+    sched.submit(sv.Request("r0", prompt_ids, max_new_tokens=128,
+                            eos_id=2, temperature=0.7, top_k=40, seed=7))
+    results = sched.run()              # rid -> RequestResult
+"""
+
+from apex_tpu.serving.engine import (
+    DecodeEngine,
+    request_key,
+    sample_tokens,
+    token_key,
+)
+from apex_tpu.serving.kv_cache import (
+    KVCache,
+    append_token,
+    init_cache,
+    prefill_into_slot,
+    release_slot,
+    valid_token_mask,
+)
+from apex_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    QueueFull,
+    Request,
+    RequestPhase,
+    RequestResult,
+)
+from apex_tpu.serving.weights import load_serving_params
+
+__all__ = [
+    "KVCache",
+    "append_token",
+    "init_cache",
+    "prefill_into_slot",
+    "release_slot",
+    "valid_token_mask",
+    "DecodeEngine",
+    "request_key",
+    "sample_tokens",
+    "token_key",
+    "ContinuousBatchingScheduler",
+    "QueueFull",
+    "Request",
+    "RequestPhase",
+    "RequestResult",
+    "load_serving_params",
+]

@@ -1,0 +1,262 @@
+"""DecodeEngine: jitted prefill + single-token decode over a KV cache.
+
+Wraps :class:`~apex_tpu.models.llama.LlamaForCausalLM` with exactly two
+compiled programs — a **prefill** (full-prompt forward that also fills
+one cache slot) and a **batched decode step** (one token per slot) —
+both shape-stable by construction: prompts are padded to a fixed
+``prefill_len``, decode always runs all ``slots`` lanes, and the cache
+is preallocated (:mod:`apex_tpu.serving.kv_cache`).  After the warmup
+call each function's jit cache holds exactly one entry no matter how
+requests arrive (`tests/test_serving.py` asserts this via
+``jax.jit``'s ``_cache_size``).
+
+Numerics contract (the acceptance bar): greedy incremental decode
+through the cache is **bit-identical** — same f32 logits — to the
+*shape-stable* uncached full-context forward (context padded to
+``max_len``, the recompile-free form a TPU server would actually run)
+at every length, and produces the identical greedy argmax stream as the
+unpadded forward, including GQA configs.  Ingredients: rope applied at
+the true position through ``_rope_freqs``'s vector-offset path,
+attention reads masked with the flash kernels' exact ``-1e30`` (masked
+``exp`` underflows to 0.0, so same-extent reductions round
+identically; see ``models.llama._decode_attention``), and logits
+through the same ``parallel_lm_logits`` head matmul as the plain
+forward (the fused LM *head-loss* kernel is training-only — serving
+has no labels).
+
+Sampling is a pure function of ``(logits, key, temperature, top_k)``
+with explicit PRNG keys — no ambient state, so a replayed request
+reproduces its exact token stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from apex_tpu._logging import get_logger
+from apex_tpu.serving.kv_cache import KVCache, init_cache
+
+__all__ = ["DecodeEngine", "sample_tokens", "request_key", "token_key"]
+
+logger = get_logger("serving.engine")
+
+
+def _sample_one(logits, base_key, index, temperature, top_k):
+    """One token from one ``[vocab]`` logits row — fully traced, so the
+    vmapped form never retraces on per-request sampling params.
+
+    The per-token key is derived *inside* the jitted sampler
+    (``fold_in(base_key, index)``, identical to :func:`token_key`): the
+    host hands over one base key per stream plus an integer index, so a
+    whole decode step's sampling is ONE dispatch — no per-slot fold_in
+    ops or device->host syncs on the serving hot path.
+
+    ``temperature <= 0`` is greedy (argmax).  ``top_k > 0`` keeps only
+    the k highest logits (threshold from a descending sort — ``top_k``
+    is a *traced* scalar, so mixed-k batches share one compile);
+    ``top_k <= 0`` means no truncation.
+    """
+    key = jax.random.fold_in(base_key, index)
+    vocab = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    thresh = jnp.sort(logits)[::-1][jnp.clip(top_k - 1, 0, vocab - 1)]
+    masked = jnp.where((top_k > 0) & (logits < thresh), -jnp.inf, logits)
+    temp = jnp.where(temperature > 0, temperature, 1.0)
+    tok = jax.random.categorical(key, masked / temp).astype(jnp.int32)
+    return jnp.where(temperature > 0, tok, greedy)
+
+
+sample_tokens = jax.jit(jax.vmap(_sample_one))
+"""Batched sampler: ``(logits [n, vocab], base_keys [n, 2], indices [n],
+temperatures [n], top_ks [n]) -> tokens [n]`` — deterministic per
+``(base_key, index)``; equals sampling with ``token_key(base, index)``."""
+
+
+def request_key(seed: int) -> jax.Array:
+    """Base PRNG key for one request (explicit, replayable)."""
+    return jax.random.PRNGKey(seed)
+
+
+def token_key(base: jax.Array, index) -> jax.Array:
+    """Key for the ``index``-th generated token of a request."""
+    return jax.random.fold_in(base, index)
+
+
+class DecodeEngine:
+    """KV-cached incremental decoding for a Llama-family model.
+
+    >>> eng = DecodeEngine(model, params, slots=8, max_len=512,
+    ...                    prefill_len=64)
+    >>> first_logits = eng.prefill(slot=0, tokens=prompt_ids)
+    >>> logits = eng.decode(tokens, active)       # one step, all slots
+    >>> eng.release(0)                            # O(1) slot reuse
+
+    The engine owns the cache functionally: every call swaps in the
+    updated :class:`KVCache`.  ``slots``/``max_len``/``prefill_len`` are
+    compile-time constants — choose ``prefill_len`` as the prompt-length
+    ceiling (prompts are right-padded to it; the padded K/V are written
+    but never readable, because per-slot lengths mask them).
+    """
+
+    def __init__(self, model, params, *, slots: int = 8,
+                 max_len: int = 512, prefill_len: int = 64,
+                 cache_dtype=None):
+        if prefill_len < 2:
+            raise ValueError("prefill_len must be >= 2 (a length-1 "
+                             "prefill is indistinguishable from a decode "
+                             "step; pad the buffer)")
+        if prefill_len > max_len:
+            raise ValueError(f"prefill_len {prefill_len} > max_len "
+                             f"{max_len}")
+        self.model = model
+        self.params = params
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.prefill_len = int(prefill_len)
+        if cache_dtype is None:
+            # serve in the params' own precision (bf16 params -> bf16
+            # cache); fall back to f32 for exotic all-int trees
+            floats = [l.dtype for l in jax.tree.leaves(params)
+                      if hasattr(l, "dtype")
+                      and jnp.issubdtype(l.dtype, jnp.floating)]
+            cache_dtype = floats[0] if floats else jnp.float32
+        self._cache = init_cache(model.config, slots=slots,
+                                 max_len=max_len, dtype=cache_dtype)
+        # host mirror of per-slot lengths: lets every call validate slot
+        # bounds and cache capacity WITHOUT a device->host sync on the
+        # decode hot path (dynamic_update_slice clamps out-of-range
+        # indices silently — overflow must be an error, not corruption)
+        self._lengths_host = np.zeros((self.slots,), np.int64)
+
+        def _prefill(params, cache, ids, slot, length):
+            # ids [1, prefill_len]; returns the logits at the LAST REAL
+            # position (the next-token distribution) + the filled cache
+            logits, cache = model.apply(params, ids, kv_cache=cache,
+                                        slot=slot)
+            cache = dataclasses.replace(
+                cache, lengths=cache.lengths.at[slot].set(length))
+            last = lax.dynamic_index_in_dim(logits[:, 0, :], length - 1,
+                                            axis=0, keepdims=False)
+            return last.astype(jnp.float32), cache
+
+        def _decode(params, cache, tokens, active):
+            # tokens [slots] int32 (last sampled per slot); active [slots]
+            # bool — inactive lanes still compute (shape stability) but
+            # never advance their length, so their writes are unreadable
+            position = cache.lengths
+            logits, cache = model.apply(params, tokens[:, None],
+                                        kv_cache=cache, position=position)
+            cache = dataclasses.replace(
+                cache,
+                lengths=cache.lengths + active.astype(jnp.int32))
+            return logits[0].astype(jnp.float32), cache
+
+        # the cache argument is donated: the engine discards the old
+        # functional copy on every call, and without aliasing each
+        # one-token step would copy the whole preallocated k/v pair
+        self._prefill = jax.jit(_prefill, donate_argnums=(1,))
+        self._decode = jax.jit(_decode, donate_argnums=(1,))
+        logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
+                     "cache_dtype=%s", self.slots, self.max_len,
+                     self.prefill_len, jnp.dtype(cache_dtype).name)
+
+    # ---- cache/slot state ------------------------------------------------
+    @property
+    def cache(self) -> KVCache:
+        return self._cache
+
+    def lengths(self) -> np.ndarray:
+        """Per-slot valid-token counts (0 = free), from the host mirror
+        — no device sync."""
+        return self._lengths_host.copy()
+
+    def free_slots(self) -> list[int]:
+        return [i for i, n in enumerate(self._lengths_host) if n == 0]
+
+    def _check_slot(self, slot: int) -> None:
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.slots})")
+
+    def release(self, slot: int) -> None:
+        """Evict a slot (O(1)); its bytes stay masked until overwritten."""
+        from apex_tpu.serving.kv_cache import release_slot
+
+        self._check_slot(slot)
+        self._cache = release_slot(self._cache, slot)
+        self._lengths_host[slot] = 0
+
+    def reset(self) -> None:
+        """Free every slot (keeps compiled programs and allocations)."""
+        self._cache = dataclasses.replace(
+            self._cache, lengths=jnp.zeros((self.slots,), jnp.int32))
+        self._lengths_host[:] = 0
+
+    def decode_compiles(self) -> int:
+        """Number of distinct compiles of the decode step (1 == the
+        shape-stable contract held: no per-request retraces)."""
+        return self._decode._cache_size()
+
+    # ---- the two compiled programs ---------------------------------------
+    def prefill(self, slot: int, tokens: Sequence[int]) -> jax.Array:
+        """Fill ``slot`` with a prompt; return its next-token logits
+        ``[vocab]`` (f32)."""
+        self._check_slot(slot)
+        if self._lengths_host[slot]:
+            raise ValueError(
+                f"slot {slot} is occupied ({self._lengths_host[slot]} "
+                f"tokens); release() it before prefilling — silently "
+                f"clobbering a live stream is the corruption class these "
+                f"guards exist for")
+        n = len(tokens)
+        if not 1 <= n <= self.prefill_len:
+            raise ValueError(f"prompt length {n} not in [1, "
+                             f"{self.prefill_len}]")
+        ids = np.zeros((1, self.prefill_len), np.int32)
+        ids[0, :n] = np.asarray(tokens, np.int32)
+        logits, self._cache = self._prefill(
+            self.params, self._cache, jnp.asarray(ids),
+            jnp.int32(slot), jnp.int32(n))
+        self._lengths_host[slot] = n
+        return logits
+
+    def decode(self, tokens, active) -> jax.Array:
+        """One batched decode step: append ``tokens[slot]`` to every
+        active slot, return per-slot next-token logits ``[slots, vocab]``
+        (f32).  Inactive lanes return garbage rows — callers mask by
+        ``active``.  Raises when an active slot is already at
+        ``max_len`` (the append would silently clobber the last cached
+        token otherwise)."""
+        act = np.asarray(active, bool)
+        full = act & (self._lengths_host >= self.max_len)
+        if full.any():
+            raise ValueError(
+                f"slots {np.flatnonzero(full).tolist()} are at cache "
+                f"capacity ({self.max_len}); release or raise max_len")
+        empty = act & (self._lengths_host == 0)
+        if empty.any():
+            raise ValueError(
+                f"slots {np.flatnonzero(empty).tolist()} are active but "
+                f"never prefilled — a decode step would expose a garbage "
+                f"token as their whole context")
+        logits, self._cache = self._decode(
+            self.params, self._cache,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(act))
+        self._lengths_host[act] += 1
+        return logits
+
+    # ---- sampling --------------------------------------------------------
+    @staticmethod
+    def sample(logits, base_keys, indices, temperatures,
+               top_ks) -> jax.Array:
+        """Vectorized deterministic sampling (see :func:`sample_tokens`)."""
+        return sample_tokens(
+            jnp.asarray(logits), jnp.asarray(base_keys),
+            jnp.asarray(indices, jnp.int32),
+            jnp.asarray(temperatures, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32))

@@ -1,0 +1,153 @@
+"""Slot-indexed decode KV cache with shape-stable, jittable updates.
+
+The serving-side win on TPUs (PAPERS.md: "Fine-Tuning and Serving Gemma
+on Google Cloud TPU") comes from never letting XLA see a new shape after
+warmup: the cache is **preallocated** at ``[layers, slots, max_len,
+kv_heads, head_dim]``, every prefill/append is a
+``lax.dynamic_update_slice`` into that fixed buffer, and attention reads
+the *whole* ``max_len`` axis with a per-slot length mask — so one
+compiled decode step serves every request mix, every sequence length,
+and every slot assignment with zero retraces.
+
+Layout choices:
+
+- One stacked ``k`` / ``v`` array over layers (not a per-layer list):
+  layer index is a Python int at trace time, so ``cache.k[i]`` is a
+  static slice, while the whole cache stays a single pytree leaf pair —
+  cheap to thread functionally through the decoder stack and to donate.
+- ``lengths[slot]`` is the number of *valid* tokens in the slot.  Bytes
+  past the length are garbage (stale evictions, prompt padding) by
+  contract; every reader must mask with :func:`valid_token_mask`.
+  Eviction is therefore O(1): zero the length, reuse the slot.
+- Updates are pure functions returning a new :class:`KVCache` (the
+  arrays are donated/aliased by XLA under jit); nothing here mutates.
+
+Masking exactness: masked attention scores sit at ``-1e30`` (the flash
+kernels' ``_NEG_INF``), so ``exp(masked - max)`` underflows to exactly
+``0.0`` and a padded-to-``max_len`` softmax/PV read is **bit-identical**
+to the unpadded computation — the property the serving parity tests
+(`tests/test_serving.py`) pin against the uncached forward.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["KVCache", "init_cache", "prefill_into_slot", "append_token",
+           "release_slot", "valid_token_mask"]
+
+
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=("k", "v", "lengths"), meta_fields=())
+@dataclasses.dataclass(frozen=True)
+class KVCache:
+    """Preallocated decode cache: one slot per in-flight request.
+
+    ``k`` / ``v``: ``[layers, slots, max_len, kv_heads, head_dim]``;
+    ``lengths``: ``[slots]`` int32 — valid tokens per slot (0 = free).
+    """
+
+    k: jax.Array
+    v: jax.Array
+    lengths: jax.Array
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def dtype(self):
+        return self.k.dtype
+
+
+def init_cache(config: Any, *, slots: int, max_len: int,
+               dtype=jnp.float32) -> KVCache:
+    """Zero-filled cache for ``config`` (a :class:`LlamaConfig`-shaped
+    object: ``num_hidden_layers``, ``kv_heads``, ``hidden_size``,
+    ``num_attention_heads``)."""
+    head_dim = config.hidden_size // config.num_attention_heads
+    shape = (config.num_hidden_layers, slots, max_len, config.kv_heads,
+             head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+                   lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def prefill_into_slot(cache: KVCache, layer: int, slot, k_seq, v_seq,
+                      start=0) -> KVCache:
+    """Write a whole (padded) prompt's K/V into one slot of one layer.
+
+    ``k_seq`` / ``v_seq``: ``[prompt_len, kv_heads, head_dim]``; ``slot``
+    and ``start`` may be traced scalars, ``layer`` is a Python int.  Does
+    NOT touch ``lengths`` — the caller sets the slot's *real* length once
+    per model call (prompt padding past it stays masked garbage).
+    """
+    upd_k = k_seq.astype(cache.dtype)[None, None]  # [1,1,P,kvh,hd]
+    upd_v = v_seq.astype(cache.dtype)[None, None]
+    idx = (jnp.int32(layer), jnp.asarray(slot, jnp.int32),
+           jnp.asarray(start, jnp.int32), jnp.int32(0), jnp.int32(0))
+    return dataclasses.replace(
+        cache,
+        k=lax.dynamic_update_slice(cache.k, upd_k, idx),
+        v=lax.dynamic_update_slice(cache.v, upd_v, idx))
+
+
+def append_token(cache: KVCache, layer: int, k_tok, v_tok,
+                 positions) -> KVCache:
+    """Write one token's K/V per slot at that slot's own position.
+
+    ``k_tok`` / ``v_tok``: ``[slots, kv_heads, head_dim]``; ``positions``:
+    ``[slots]`` int32 (normally ``cache.lengths`` — the next free index).
+    A vmapped ``dynamic_update_slice`` keeps the write shape-stable: the
+    batched decode step compiles once no matter how slot positions drift
+    apart under continuous batching.
+    """
+    def write_one(buf, tok, pos):  # buf [max_len, kvh, hd]
+        return lax.dynamic_update_slice(
+            buf, tok.astype(buf.dtype)[None], (pos, 0, 0))
+
+    pos = jnp.asarray(positions, jnp.int32)
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[layer].set(jax.vmap(write_one)(cache.k[layer], k_tok,
+                                                    pos)),
+        v=cache.v.at[layer].set(jax.vmap(write_one)(cache.v[layer], v_tok,
+                                                    pos)))
+
+
+def release_slot(cache: KVCache, slot) -> KVCache:
+    """Free a slot for reuse: O(1) — zero its length, leave the bytes.
+
+    Stale K/V past ``lengths`` are unreadable by contract (every read
+    masks with :func:`valid_token_mask`), so eviction never touches the
+    cache payload and the next prefill simply overwrites.
+    """
+    return dataclasses.replace(
+        cache, lengths=cache.lengths.at[jnp.asarray(slot)].set(0))
+
+
+def valid_token_mask(positions, max_len: int):
+    """``[slots, max_len]`` bool: True where ``idx <= position``.
+
+    ``positions`` is the index of each slot's *current* token (visible to
+    itself), i.e. the pre-append ``cache.lengths``.  This is THE cache
+    read mask — ``models.llama._decode_attention`` applies it to the
+    attention scores, so masking semantics live here exactly once.
+    (``.astype(jnp.int32)`` turns it into segment ids for
+    ``flash_attention(segment_ids=...)`` if a kernel path ever wants it.)
+    """
+    idx = jnp.arange(max_len, dtype=jnp.int32)[None, :]
+    return idx <= jnp.asarray(positions, jnp.int32)[:, None]

@@ -1,0 +1,314 @@
+"""Continuous batching: a host loop that keeps decode slots full.
+
+The device-side contract (PAPERS.md: "Exploring the limits of
+Concurrency in ML Training on Google TPUs" — keep the host off the
+device critical path) is that the *only* per-step device work is the one
+compiled batched decode step; everything here — admission, eviction,
+sampling bookkeeping, telemetry — is cheap host logic at step
+boundaries:
+
+- **Bounded queue**: ``submit`` rejects past ``max_queue`` with
+  :class:`QueueFull` (backpressure belongs to the caller, not a silent
+  unbounded buffer).
+- **Slot admission**: at each step boundary, free slots are filled from
+  the queue in FIFO order (no starvation: a request's wait is bounded by
+  the streams ahead of it) — one prefill per admitted request, then the
+  shared decode step serves every active slot.
+- **Per-request state machine**: QUEUED → PREFILL → DECODE → DONE, with
+  eviction on EOS or ``max_new_tokens`` and *immediate* slot reuse at
+  the same step boundary.
+- **Telemetry**: structured ``emit_event`` lines
+  (:mod:`apex_tpu._logging`) — ``serving_request_admitted`` /
+  ``serving_first_token`` (time-to-first-token) /
+  ``serving_request_finished`` (tokens/s, mean per-token latency) per
+  request, and a ``serving_step`` sample (queue depth, active slots)
+  every ``log_interval`` steps.
+
+Determinism: sampling draws from explicit per-request PRNG keys
+(``fold_in(PRNGKey(seed), token_index)``) — the clock feeds telemetry
+only, never token choice, so a replay with the same seeds reproduces
+every stream bit-for-bit regardless of arrival timing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.serving.engine import DecodeEngine, request_key
+
+__all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
+           "ContinuousBatchingScheduler"]
+
+logger = get_logger("serving.scheduler")
+
+
+class QueueFull(RuntimeError):
+    """The bounded request queue is at capacity — apply backpressure."""
+
+
+class RequestPhase(enum.Enum):
+    QUEUED = "queued"
+    PREFILL = "prefill"
+    DECODE = "decode"
+    DONE = "done"
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One generation request (sampling config rides along).
+
+    ``temperature <= 0`` is greedy; ``top_k <= 0`` means no truncation.
+    ``eos_id=None`` disables EOS eviction (run to ``max_new_tokens``).
+    """
+
+    rid: str
+    prompt: Sequence[int]
+    max_new_tokens: int
+    eos_id: Optional[int] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Completed stream + the latency telemetry the events carried."""
+
+    rid: str
+    tokens: List[int]
+    finish_reason: str                 # "eos" | "length"
+    ttft_s: float                      # submit -> first token
+    total_s: float                     # submit -> finished
+    tokens_per_s: float
+
+
+@dataclasses.dataclass
+class _Active:
+    request: Request
+    slot: int
+    base_key: np.ndarray     # host copy; folded per token INSIDE the sampler
+    tokens: List[int]
+    t_submit: float
+    t_first: float
+    phase: RequestPhase = RequestPhase.DECODE
+
+
+class ContinuousBatchingScheduler:
+    """FIFO continuous batching over one :class:`DecodeEngine`.
+
+    >>> sched = ContinuousBatchingScheduler(engine, max_queue=64)
+    >>> sched.submit(Request("r0", prompt, max_new_tokens=32, eos_id=2))
+    >>> results = sched.run()          # drain queue + all active slots
+    """
+
+    def __init__(self, engine: DecodeEngine, *, max_queue: int = 64,
+                 log_interval: int = 32,
+                 clock: Callable[[], float] = time.monotonic):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.log_interval = max(1, int(log_interval))
+        self._clock = clock
+        self._queue: deque[tuple[Request, float]] = deque()
+        self._active: Dict[int, _Active] = {}
+        self._results: Dict[str, RequestResult] = {}
+        self._step_index = 0
+
+    # ---- submission ------------------------------------------------------
+    def submit(self, request: Request) -> None:
+        """Enqueue; raises :class:`QueueFull` at ``max_queue`` and
+        ``ValueError`` for requests the engine can never serve."""
+        rid = request.rid
+        if (rid in self._results
+                or any(r.rid == rid for r, _ in self._queue)
+                or any(st.request.rid == rid
+                       for st in self._active.values())):
+            raise ValueError(
+                f"duplicate rid {rid!r}: already "
+                f"{'finished' if rid in self._results else 'in flight'} "
+                f"— two streams under one rid would overwrite each "
+                f"other's results")
+        n = len(request.prompt)
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"{request.rid}: max_new_tokens must be >= 1 "
+                f"(got {request.max_new_tokens})")
+        if not 1 <= n <= self.engine.prefill_len:
+            raise ValueError(
+                f"{request.rid}: prompt length {n} not in [1, "
+                f"{self.engine.prefill_len}] (engine prefill buffer)")
+        # the FINAL sampled token is never appended (the request finishes
+        # right after sampling it), so peak cache use is one less than
+        # prompt + output budget — a stream may fill the cache exactly
+        if n + request.max_new_tokens - 1 > self.engine.max_len:
+            raise ValueError(
+                f"{request.rid}: prompt {n} + max_new_tokens "
+                f"{request.max_new_tokens} needs "
+                f"{n + request.max_new_tokens - 1} cached positions, "
+                f"over cache max_len {self.engine.max_len}")
+        if len(self._queue) >= self.max_queue:
+            raise QueueFull(f"queue at capacity ({self.max_queue})")
+        self._queue.append((request, self._clock()))
+        emit_event("serving_request_queued", rid=request.rid,
+                   prompt_tokens=n, queue_depth=len(self._queue))
+
+    # ---- introspection ---------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    @property
+    def steps_run(self) -> int:
+        return self._step_index
+
+    def phase_of(self, rid: str) -> RequestPhase:
+        if rid in self._results:
+            return RequestPhase.DONE
+        for st in self._active.values():
+            if st.request.rid == rid:
+                return st.phase
+        return RequestPhase.QUEUED
+
+    # ---- the loop --------------------------------------------------------
+    def _admit(self) -> List[str]:
+        """Fill free slots from the queue (FIFO), one prefill each; the
+        first token is sampled from the prefill logits so TTFT includes
+        exactly one prefill + zero decode steps.  Returns rids that
+        finished already at admission (one-token requests, instant EOS)."""
+        finished: List[str] = []
+        while self._queue:
+            # the engine's slot-occupancy mirror is the ONE source of
+            # truth for free slots (a scheduler-side copy could desync
+            # from direct engine use and strand requests)
+            free = [s for s in self.engine.free_slots()
+                    if s not in self._active]
+            if not free:
+                break
+            request, t_submit = self._queue.popleft()
+            slot = free[0]
+            st = _Active(request=request, slot=slot,
+                         base_key=np.asarray(request_key(request.seed)),
+                         tokens=[], t_submit=t_submit, t_first=0.0,
+                         phase=RequestPhase.PREFILL)
+            logits = self.engine.prefill(slot, request.prompt)
+            tok = int(self.engine.sample(
+                logits[None], st.base_key[None], np.int32([0]),
+                np.float32([request.temperature]),
+                np.int32([request.top_k]))[0])
+            st.t_first = self._clock()
+            st.tokens.append(tok)
+            st.phase = RequestPhase.DECODE
+            self._active[slot] = st
+            logger.debug("admitted %s into slot %d (queue %d deep)",
+                         request.rid, slot, len(self._queue))
+            emit_event("serving_request_admitted", rid=request.rid,
+                       slot=slot, queue_depth=len(self._queue))
+            emit_event("serving_first_token", rid=request.rid,
+                       ttft_s=round(st.t_first - t_submit, 6))
+            if self._finish_if_done(st):
+                finished.append(request.rid)
+        return finished
+
+    def _finish_if_done(self, st: _Active) -> bool:
+        request = st.request
+        done_eos = (request.eos_id is not None and st.tokens
+                    and st.tokens[-1] == request.eos_id)
+        done_len = len(st.tokens) >= request.max_new_tokens
+        if not (done_eos or done_len):
+            return False
+        now = self._clock()
+        total = max(now - st.t_submit, 1e-9)
+        result = RequestResult(
+            rid=request.rid, tokens=list(st.tokens),
+            finish_reason="eos" if done_eos else "length",
+            ttft_s=st.t_first - st.t_submit, total_s=total,
+            tokens_per_s=len(st.tokens) / total)
+        st.phase = RequestPhase.DONE
+        self._results[request.rid] = result
+        self._active.pop(st.slot, None)
+        self.engine.release(st.slot)     # immediate slot reuse
+        # per_token_ms measures the DECODE path only (first token to
+        # finish): queue wait and prefill live in ttft_s, so the field
+        # stays meaningful for decode-latency diagnosis under load
+        decode_s = max(now - st.t_first, 0.0)
+        decode_steps = max(len(st.tokens) - 1, 1)
+        emit_event("serving_request_finished", rid=request.rid,
+                   finish_reason=result.finish_reason,
+                   new_tokens=len(result.tokens),
+                   tokens_per_s=round(result.tokens_per_s, 3),
+                   per_token_ms=round(decode_s / decode_steps * 1e3, 3))
+        return True
+
+    def step(self) -> List[str]:
+        """One step boundary: admit into free slots, then one shared
+        decode step for every active slot.  Returns rids finished at
+        this boundary."""
+        finished = self._admit()
+        if self._active:
+            slots = self.engine.slots
+            tokens = np.zeros((slots,), np.int32)
+            active = np.zeros((slots,), bool)
+            base_keys = np.zeros((slots, 2), np.uint32)
+            indices = np.zeros((slots,), np.int32)
+            temps = np.zeros((slots,), np.float32)
+            top_ks = np.zeros((slots,), np.int32)
+            for slot, st in self._active.items():
+                tokens[slot] = st.tokens[-1]
+                active[slot] = True
+                base_keys[slot] = st.base_key
+                indices[slot] = len(st.tokens)
+                temps[slot] = st.request.temperature
+                top_ks[slot] = st.request.top_k
+            # per-step device work: ONE decode dispatch + ONE sampler
+            # dispatch (keys fold inside the sampler) + one readback
+            logits = self.engine.decode(tokens, active)
+            sampled = np.asarray(self.engine.sample(
+                logits, base_keys, indices, temps, top_ks))
+            for slot, st in list(self._active.items()):
+                st.tokens.append(int(sampled[slot]))
+                if self._finish_if_done(st):
+                    finished.append(st.request.rid)
+        self._step_index += 1
+        if self._step_index % self.log_interval == 0:
+            emit_event("serving_step", step=self._step_index,
+                       queue_depth=len(self._queue),
+                       active_slots=len(self._active))
+        return finished
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> Dict[str, RequestResult]:
+        """Drive :meth:`step` until queue and slots drain (or
+        ``max_steps``); returns rid -> :class:`RequestResult`."""
+        steps = 0
+        while self._queue or self._active:
+            if max_steps is not None and steps >= max_steps:
+                break
+            self.step()
+            steps += 1
+        return dict(self._results)
+
+    @property
+    def results(self) -> Dict[str, RequestResult]:
+        return dict(self._results)
+
+    def pop_result(self, rid: str) -> RequestResult:
+        """Claim (and forget) one finished result.  Long-running drivers
+        should pop results as :meth:`step` reports them finished —
+        unclaimed results are retained indefinitely (and their rids stay
+        reserved by the duplicate guard)."""
+        return self._results.pop(rid)
+
+    def pop_results(self) -> Dict[str, RequestResult]:
+        """Claim (and forget) every finished result."""
+        out, self._results = self._results, {}
+        return out

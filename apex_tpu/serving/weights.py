@@ -1,0 +1,112 @@
+"""Load serving params from a resilience checkpoint root.
+
+Serving restarts from whatever training last proved durable: the newest
+*valid* step under the root (corrupt or truncated candidates are
+skipped exactly as a training restart would skip them, and validation
+is fused into the single restore pass — no separate pre-validating
+read of a multi-GB payload), read through the matching loader for its
+manifest format —
+v1 whole-tree (:mod:`apex_tpu.resilience.checkpoint`) or v2 sharded
+(:mod:`apex_tpu.resilience.elastic`, which reshards onto the template's
+mesh; a single-host serving process just gets the reassembled global
+leaves).  A mixed v1/v2 root works: the format is read per step
+directory, not assumed for the root.
+
+Training checkpoints usually persist a whole train state (params +
+optimizer moments + scaler + rng); serving needs only the params
+subtree, so ``params_key`` selects it *after* the strict full-tree
+restore (the restore layer's structure check stays authoritative).
+``policy`` (an :class:`apex_tpu.amp.policy.PrecisionPolicy`, e.g.
+``amp.policy.O2()``) then casts for half-precision serving — bf16
+matmul weights, norm-like leaves pinned fp32 — the same cast training
+applied, so served numerics match the trained model's eval numerics.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Optional
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.resilience import checkpoint as _ckpt
+from apex_tpu.resilience.checkpoint import CheckpointError
+
+__all__ = ["load_serving_params"]
+
+logger = get_logger("serving.weights")
+
+
+def load_serving_params(root: str, like: Any, *,
+                        params_key: Optional[str] = None,
+                        policy: Any = None,
+                        step: Optional[int] = None) -> tuple[Any, int]:
+    """Restore serving params from checkpoint ``root``.
+
+    Args:
+      root: a resilience checkpoint root (v1, v2/sharded, or mixed).
+      like: template pytree with the **saved** structure (the full train
+        state the training loop persisted, not just params).
+      params_key: top-level key selecting the params subtree of the
+        restored tree (``None`` = the whole tree is the params).
+      policy: optional :class:`~apex_tpu.amp.policy.PrecisionPolicy`;
+        its ``cast_params`` is applied to the selected subtree (bf16
+        serving with fp32 norms under ``amp.policy.O2()``).
+      step: pin an exact step instead of the newest-valid walk.
+
+    Returns ``(params, step)``.  Raises :class:`CheckpointError` when no
+    valid checkpoint exists (or the pinned step is invalid).
+    """
+    t0 = time.monotonic()
+    candidates = ([step] if step is not None
+                  else list(reversed(_ckpt._list_steps(root))))
+    if not candidates:
+        raise CheckpointError(f"no checkpoints under {root!r}")
+    tree = None
+    errors: list[str] = []
+    for got in candidates:
+        step_dir = os.path.join(root, _ckpt._step_dirname(got))
+        try:
+            # CHEAP structural probe only — the format dispatch; the one
+            # full CRC pass happens inside the restore itself (a
+            # pre-validating latest_valid_step() would read and CRC the
+            # whole multi-GB payload twice on server boot)
+            manifest = _ckpt._read_manifest(step_dir)
+            logger.debug("serving weights from %s (format v%s)", step_dir,
+                         manifest.get("format_version", 1))
+            sharded = (manifest.get("format_version")
+                       == _ckpt._SHARDED_FORMAT_VERSION)
+            if sharded:
+                from apex_tpu.resilience.elastic import (
+                    restore_sharded_checkpoint,
+                )
+
+                tree, got = restore_sharded_checkpoint(root, like,
+                                                       step=got)
+            else:
+                tree, got = _ckpt.restore_checkpoint(root, like, step=got)
+            break
+        except CheckpointError as e:
+            # newest-valid fallback walk, same contract as a training
+            # restart (the restore layer already emitted
+            # checkpoint_rejected for CRC-level damage)
+            errors.append(str(e))
+            if step is not None:
+                raise
+    if tree is None:
+        raise CheckpointError(
+            f"no valid checkpoint under {root!r}; rejected: {errors}")
+    if params_key is not None:
+        try:
+            tree = tree[params_key]
+        except (KeyError, TypeError) as e:
+            raise CheckpointError(
+                f"{step_dir}: restored tree has no {params_key!r} "
+                f"subtree to serve from") from e
+    if policy is not None:
+        tree = policy.cast_params(tree)
+    emit_event("serving_weights_loaded", step=int(got),
+               format_version=int(manifest.get("format_version", 1)),
+               sharded=sharded, params_key=params_key,
+               opt_level=getattr(policy, "opt_level", None), t0=t0)
+    return tree, got

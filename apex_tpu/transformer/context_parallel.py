@@ -62,7 +62,7 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True,
     shard ``[b, h, s_local, d]`` in q's dtype; numerics match dense
     attention over the gathered sequence.
     """
-    n = jax.lax.axis_size(axis_name)
+    n = jax.lax.psum(1, axis_name)  # static size; 0.4.x has no axis_size
     my = jax.lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     if scale is None:

@@ -80,7 +80,7 @@ class ExpertParallelMLP(nn.Module):
     def __call__(self, x) -> Tuple[jax.Array, jax.Array]:
         t, h = x.shape
         ffn = self.ffn_hidden_size or 4 * h
-        ep = (jax.lax.axis_size(self.axis_name)
+        ep = (jax.lax.psum(1, self.axis_name)  # static; no axis_size in 0.4.x
               if self.axis_name is not None else 1)
         if self.num_experts % ep:
             raise ValueError(f"num_experts ({self.num_experts}) must divide "

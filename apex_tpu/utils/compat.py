@@ -1,0 +1,38 @@
+"""JAX version-compatibility shims, probed once at import.
+
+Two renames keep biting every shard_map call site on this codebase's
+jax 0.4.x floor:
+
+- ``shard_map`` moved from ``jax.experimental.shard_map`` to the top
+  level in jax >= 0.6;
+- its replication-check kwarg was renamed ``check_rep`` (0.4.x) ->
+  ``check_vma``.
+
+This module is the ONE place that knows both (the probe previously
+lived copy-pasted in ``resilience.consistency``, ``__graft_entry__``
+and two test files — a future jax rename now lands here only):
+
+    from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
+    f = shard_map(fn, mesh=mesh, in_specs=..., out_specs=...,
+                  **NO_REP_CHECK)
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.6 exports it at top level
+    from jax import shard_map
+except ImportError:  # jax 0.4.x keeps it under experimental
+    from jax.experimental.shard_map import shard_map
+
+# Disabling the replication checker is the repo-wide default for
+# shard_map: the collective helpers mix per-leaf specs and produce
+# outputs made replicated by explicit psum/all_gather, which older
+# rep-checkers reject conservatively.
+NO_REP_CHECK = (
+    {"check_vma": False}
+    if "check_vma" in inspect.signature(shard_map).parameters
+    else {"check_rep": False})
+
+__all__ = ["NO_REP_CHECK", "shard_map"]

@@ -38,6 +38,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow' (ROADMAP.md); register the marker so
+    # slow-marked long benchmarks don't trip UnknownMarkWarning
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running test excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

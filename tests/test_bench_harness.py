@@ -247,6 +247,27 @@ def test_elastic_metrics_block():
         assert r[k] > 0.0, k
 
 
+def test_serving_metrics_block():
+    """The serving block (ISSUE 4 satellite): prefill tokens/s, per-token
+    decode latency, and continuous-batching throughput at 1/4/8 streams
+    with staggered arrivals — plus the shape-stability invariant (ONE
+    decode compile after warmup)."""
+    r = bench._serving_metrics(decode_tokens=12, prompt_len=4,
+                               prefill_len=8, max_len=64, slots=8)
+    assert r["ok"] is True
+    assert r["prefill_tokens_per_s"] > 0.0
+    assert r["decode_ms_per_token"] > 0.0
+    assert set(r["throughput_tokens_per_s"]) == {"1", "4", "8"}
+    for tps in r["throughput_tokens_per_s"].values():
+        assert tps > 0.0
+    assert r["speedup_4_vs_sequential"] > 0.0
+    # the decode step function must compile exactly once per engine no
+    # matter how streams arrive — retraces would be the recompile tax
+    # the slotted cache exists to eliminate
+    assert r["decode_compiles_after_warmup"] == 1
+    assert r["config"]["slots"] == 8
+
+
 def test_cpu_smoke_end_to_end(monkeypatch):
     """The real measurement path on the real (CPU) backend.
 
@@ -267,3 +288,4 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     assert result["recovery"]["ok"] is True
     assert result["supervisor"]["ok"] is True
     assert result["elastic"]["ok"] is True
+    assert result["serving"]["ok"] is True

@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK as _NO_REP_CHECK
+from apex_tpu.utils.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -226,7 +227,7 @@ def test_tensor_parallel_matches_single(devices, rng):
         with mesh:
             out = jax.jit(shard_map(
                 run, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
-                check_vma=False))(params, ids)
+                **_NO_REP_CHECK))(params, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
     finally:

@@ -87,9 +87,14 @@ PAGES = {
         "apex_tpu.resilience.retry",
         "apex_tpu.resilience.data_guard",
     ]),
+    "serving": ("Serving (KV-cached decode + continuous batching)", [
+        "apex_tpu.serving", "apex_tpu.serving.kv_cache",
+        "apex_tpu.serving.engine", "apex_tpu.serving.scheduler",
+        "apex_tpu.serving.weights",
+    ]),
     "utils": ("Utilities", [
         "apex_tpu.utils.nvtx", "apex_tpu.utils.packing",
-        "apex_tpu.utils.serialization",
+        "apex_tpu.utils.serialization", "apex_tpu.utils.compat",
         "apex_tpu.feature_registry", "apex_tpu._logging",
     ]),
 }
@@ -348,6 +353,74 @@ SupervisorConfig(consistency_check_interval=K))` runs every K steps,
 persisted); an unrepairable desync (`ReplicaDesyncError`) counts as one
 unrecovered failure in the same escalation ladder as every other fault.
 """,
+    "serving": """\
+Serve a trained Llama from its resilience checkpoints: slotted KV-cached
+incremental decode plus continuous batching, with exactly two compiled
+device programs after warmup.  Every path below runs under tier-1 on CPU
+(`tests/test_serving.py`), including the bit-parity acceptance run.
+
+## Cache layout
+
+The decode cache is **preallocated** and slot-indexed:
+
+```
+k, v:     [layers, slots, max_len, kv_heads, head_dim]
+lengths:  [slots]  int32   # valid tokens per slot; 0 = free
+```
+
+One slot per in-flight request.  Prefill writes a whole (padded) prompt
+with one `lax.dynamic_update_slice`; each decode step appends one token
+per slot at that slot's own depth (a vmapped dynamic-update — per-slot
+positions drift apart freely under continuous batching without changing
+any shape).  Attention always reads the full `max_len` axis under a
+per-slot length mask whose masked scores sit at the flash kernels'
+exact `-1e30`: `exp(masked - max)` underflows to exactly `0.0`, so the
+fixed-extent softmax is *bit-identical* to a same-extent uncached
+forward — masking is correctness, not approximation.  Bytes past
+`lengths` (prompt padding, evicted streams) are garbage by contract and
+unreadable by construction.
+
+## Slot lifecycle
+
+`QUEUED → PREFILL → DECODE → DONE`.  The scheduler admits queued
+requests into free slots at each step boundary (FIFO — a request's wait
+is bounded by the streams ahead of it, so no starvation), runs one
+shared batched decode step for every active slot, and evicts on EOS or
+`max_new_tokens` with **O(1)** slot release (zero the length, reuse
+immediately; the next prefill overwrites).  Admission, eviction, and
+sampling bookkeeping are host-side work at step boundaries — the device
+only ever sees the two compiled programs, and the decode step compiles
+**exactly once** (asserted via `jax.jit` cache stats in tier-1: no
+per-request retraces, the recompile tax the slotted cache exists to
+eliminate).
+
+## Determinism guarantees
+
+- **Greedy decode is bit-identical to the uncached model**: the
+  acceptance test decodes 64+ tokens through the cache on a GQA config
+  and proves every step's f32 logits exactly equal to the shape-stable
+  uncached forward (context padded to `max_len`), and the greedy stream
+  identical to the unpadded forward.
+- **Sampling is a pure function** of `(logits, key, temperature,
+  top_k)`: per-request PRNG keys derive as
+  `fold_in(PRNGKey(seed), token_index)`, the clock feeds telemetry
+  only, and a replay with the same seeds reproduces every stream
+  bit-for-bit regardless of arrival timing or slot assignment.
+- **Streams are isolated**: evicting a neighbor slot and admitting a
+  new request into it mid-flight does not move any other stream's
+  logits by a single bit (tier-1 pins this).
+
+## Telemetry
+
+Structured `emit_event` lines ride the `apex_tpu.events` logger:
+`serving_request_queued` / `serving_request_admitted` (queue depth),
+`serving_first_token` (TTFT), `serving_request_finished` (tokens/s,
+per-token latency, finish reason), and a periodic `serving_step` sample
+(queue depth, active slots).  `bench.py` captures a `serving` block —
+prefill tokens/s, steady-state decode ms/token, and continuous-batching
+aggregate throughput at 1/4/8 concurrent streams with staggered
+arrivals (4 concurrent streams ≥ 2× four sequential runs).
+""",
 }
 
 
@@ -565,6 +638,37 @@ never runs arithmetic on the bytes — resuming on `(dp=2, tp=4)` or
 `dp=8` is bit-identical to the `(dp=4, tp=2)` save.  A **v1**
 (whole-tree) checkpoint cannot reshard: restoring one onto a different
 mesh raises `CheckpointError` instead of silently resharding wrong.
+
+Serve a trained checkpoint — start from the SAME resilience checkpoint
+root the training loop wrote (v1 whole-tree and v2 sharded both load;
+the newest *valid* step wins, exactly like a training restart), cast
+for bf16 serving through the amp policy, and run KV-cached continuous
+batching ([full page](api/serving.md)):
+
+```python
+from apex_tpu import amp, serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+
+model = LlamaForCausalLM(LlamaConfig.llama2_7b())
+template = {"params": params_template, "opt": opt_template,
+            "scaler": sstate, "rng": rng}          # the SAVED structure
+params, step = sv.load_serving_params(
+    "/ckpts/run7", like=template, params_key="params",
+    policy=amp.policy.O2())                        # bf16, norms fp32
+
+eng = sv.DecodeEngine(model, params, slots=8, max_len=2048,
+                      prefill_len=256)             # 2 compiled programs
+sched = sv.ContinuousBatchingScheduler(eng, max_queue=64)
+sched.submit(sv.Request("r0", prompt_ids, max_new_tokens=128, eos_id=2,
+                        temperature=0.7, top_k=40, seed=7))
+results = sched.run()          # rid -> RequestResult (tokens, TTFT, tps)
+```
+
+Slots admit from the bounded FIFO queue at every step boundary and free
+on EOS/max-tokens with immediate reuse; the decode step compiles once
+and never retraces, no matter how requests arrive.  Greedy decode
+through the cache is bit-identical to the uncached forward (the tier-1
+acceptance test), and sampling replays exactly from its explicit seeds.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -21,6 +21,10 @@ independently usable components, not a monolithic trainer:
                                    optimizers, and other specialized ops.
 - :mod:`apex_tpu.resilience`     — validated atomic checkpointing, fault
                                    injection, anomaly-aware step skipping.
+- :mod:`apex_tpu.serving`        — slotted KV-cache decode + continuous
+                                   batching over the model zoo.
+- :mod:`apex_tpu.obs`            — metrics registry, span tracing, and
+                                   Prometheus/Chrome-trace exporters.
 
 Unlike the reference there are no build-time extension flags: every component
 is pure JAX (Pallas kernels JIT-compile on TPU; jnp fallbacks run anywhere).
@@ -52,6 +56,8 @@ _SUBMODULES = (
     "contrib",
     "ops",
     "resilience",
+    "serving",
+    "obs",
     "utils",
     "feature_registry",
 )

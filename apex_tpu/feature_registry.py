@@ -71,3 +71,5 @@ register("supervisor", "step watchdog + heartbeat + transient retry + data guard
          False, "host threads + I/O")
 register("serving", "slotted KV-cache decode + continuous batching + checkpoint serving",
          False, "jnp/XLA + host scheduler")
+register("obs", "metrics registry + span tracing + Prometheus/Chrome-trace exporters",
+         False, "host-side stdlib")

@@ -1,0 +1,87 @@
+"""Unified observability: metrics registry, span tracing, exporters.
+
+Apex's value is *measurable* performance; this package is the layer
+that makes it measurable in-process instead of via log grep:
+
+- :mod:`apex_tpu.obs.metrics` — thread-safe process-local registry of
+  ``Counter`` / ``Gauge`` / ``Histogram`` (fixed log-spaced latency
+  buckets, labeled series) with Prometheus text exposition and atomic
+  JSON file export.
+- :mod:`apex_tpu.obs.trace` — nestable context-manager spans on the
+  monotonic clock (contextvars parent linkage, per-thread safe)
+  exported as Chrome/Perfetto trace-event JSON, plus an opt-in
+  ``jax.profiler`` start/stop hook for profiling a stall on demand.
+- :mod:`apex_tpu.obs.bridge` — the sink
+  :func:`apex_tpu._logging.emit_event` fans out to, so every existing
+  structured event (checkpoint saved/rejected, retry attempt/exhausted,
+  replica desync, serving queued/first-token/finished, batch skipped)
+  automatically increments a counter and stamps the active span — zero
+  call-site churn.  Installed on import.
+
+The resilience supervisor, checkpoint manager, serving scheduler/engine
+and pipeline timers all publish into the default registry; see
+``docs/api/observability.md`` for the metric inventory, naming
+conventions, and the "watch a training job live" recipe.  With no
+exporter attached the per-update overhead is a lock + dict write
+(``bench.py``'s ``obs`` block keeps it honest).
+"""
+
+from apex_tpu.obs import bridge, metrics, trace
+from apex_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+    counter,
+    gauge,
+    histogram,
+    prometheus_text,
+    snapshot,
+    write_json,
+)
+from apex_tpu.obs.trace import (
+    Span,
+    TraceRecorder,
+    current_span,
+    install_recorder,
+    profile_on_stall,
+    recording,
+    span,
+    start_jax_profiler,
+    stop_jax_profiler,
+    uninstall_recorder,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS_S",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "REGISTRY",
+    "Span",
+    "TraceRecorder",
+    "bridge",
+    "counter",
+    "current_span",
+    "gauge",
+    "histogram",
+    "install_recorder",
+    "metrics",
+    "profile_on_stall",
+    "prometheus_text",
+    "recording",
+    "snapshot",
+    "span",
+    "start_jax_profiler",
+    "stop_jax_profiler",
+    "trace",
+    "uninstall_recorder",
+    "write_json",
+]
+
+# events start feeding the registry the moment any instrumented
+# subsystem imports obs; emit_event log output is byte-identical either way
+bridge.install()

@@ -1,0 +1,188 @@
+"""Event → metric/span bridge: every ``emit_event`` feeds the registry.
+
+The repo already has a complete structured-event vocabulary
+(``checkpoint_saved`` / ``checkpoint_rejected``, ``retry_attempt`` /
+``retry_exhausted``, ``replica_desync``, ``batch_skipped``,
+``serving_request_queued`` / ``serving_first_token`` /
+``serving_request_finished``, ``watchdog_stall``, ``fault_injected``,
+…) — but events are log lines, and log lines cannot answer "how many,
+how fast, right now".  This module subscribes one sink to
+:func:`apex_tpu._logging.add_event_sink` that, for every event:
+
+1. increments ``apex_events_total{event=<kind>}`` — every event kind is
+   countable with **zero call-site churn**;
+2. stamps the kind onto the active trace span (so a trace of a slow
+   step shows the retries/skips that happened inside it);
+3. runs a per-kind handler for the events whose payloads carry real
+   measurements (TTFT and per-token latency histograms, retry/skip/
+   desync counters, …).
+
+Installed automatically when :mod:`apex_tpu.obs` is imported (which the
+supervisor, checkpoint manager, and serving scheduler all do), and
+idempotent.  The default log sink is untouched: ``emit_event`` output
+stays byte-identical with or without the bridge.
+
+Serving **gauges** (queue depth, slot occupancy, cache utilization,
+decode compiles) are declared here but *set directly* by the scheduler
+each step — a gauge describes current state, and routing it through the
+event stream would tie its freshness to ``log_interval``.  Pipeline
+timers publish through :data:`TIMER_SECONDS` via
+``Timers.publish_metrics()``.
+"""
+
+from __future__ import annotations
+
+from apex_tpu import _logging
+from apex_tpu.obs import metrics, trace
+
+__all__ = ["install", "uninstall", "installed"]
+
+# -- the metric inventory (each name registered at exactly ONE call site;
+#    tools/check_metrics.py enforces naming + uniqueness + documentation
+#    in docs/api/observability.md) ------------------------------------------
+
+EVENTS_TOTAL = metrics.counter(
+    "apex_events_total", "structured emit_event lines by kind", ("event",))
+RETRY_ATTEMPTS = metrics.counter(
+    "apex_retry_attempts_total",
+    "transient-failure retry attempts by call site", ("what",))
+RETRY_EXHAUSTED = metrics.counter(
+    "apex_retry_exhausted_total",
+    "retries that ran out of attempts, by call site", ("what",))
+BATCHES_SKIPPED = metrics.counter(
+    "apex_batches_skipped_total",
+    "corrupt batches dropped by the data guard")
+REPLICA_DESYNC = metrics.counter(
+    "apex_replica_desync_total",
+    "diverged (leaf, replica) observations from verify_replicas")
+SUPERVISOR_FAILURES = metrics.counter(
+    "apex_supervisor_failures_total",
+    "unrecovered supervisor failures by exception type", ("failure",))
+WATCHDOG_STALLS = metrics.counter(
+    "apex_watchdog_stalls_total",
+    "step-deadline violations observed by the watchdog")
+FAULTS_INJECTED = metrics.counter(
+    "apex_faults_injected_total",
+    "deterministic test faults fired, by fault kind", ("fault",))
+CHECKPOINTS_REJECTED = metrics.counter(
+    "apex_checkpoints_rejected_total",
+    "checkpoints skipped by the newest-valid fallback walk")
+SERVING_TTFT = metrics.histogram(
+    "apex_serving_ttft_seconds",
+    "request submit -> first token (queue wait + prefill)")
+SERVING_PER_TOKEN = metrics.histogram(
+    "apex_serving_decode_per_token_seconds",
+    "steady-state decode latency per generated token")
+SERVING_TOKENS_PER_S = metrics.gauge(
+    "apex_serving_tokens_per_second",
+    "throughput of the most recently finished request")
+SERVING_QUEUE_DEPTH = metrics.gauge(
+    "apex_serving_queue_depth", "requests waiting for a decode slot")
+SERVING_SLOT_OCCUPANCY = metrics.gauge(
+    "apex_serving_slot_occupancy", "active decode slots / total slots")
+SERVING_CACHE_UTILIZATION = metrics.gauge(
+    "apex_serving_cache_utilization",
+    "filled KV-cache positions / total capacity")
+SERVING_DECODE_COMPILES = metrics.gauge(
+    "apex_serving_decode_compiles",
+    "distinct compiles of the batched decode step (1 == shape-stable)")
+TIMER_SECONDS = metrics.gauge(
+    "apex_timer_seconds",
+    "pipeline Timers accumulated seconds by region", ("region",))
+
+
+def _on_retry_attempt(event: dict) -> None:
+    RETRY_ATTEMPTS.inc(what=str(event.get("what", "unknown")))
+
+
+def _on_retry_exhausted(event: dict) -> None:
+    RETRY_EXHAUSTED.inc(what=str(event.get("what", "unknown")))
+
+
+def _on_batch_skipped(event: dict) -> None:
+    BATCHES_SKIPPED.inc()
+
+
+def _on_replica_desync(event: dict) -> None:
+    REPLICA_DESYNC.inc()
+
+
+def _on_supervisor_failure(event: dict) -> None:
+    SUPERVISOR_FAILURES.inc(failure=str(event.get("failure", "unknown")))
+
+
+def _on_watchdog_stall(event: dict) -> None:
+    WATCHDOG_STALLS.inc()
+
+
+def _on_fault_injected(event: dict) -> None:
+    FAULTS_INJECTED.inc(fault=str(event.get("fault", "unknown")))
+
+
+def _on_checkpoint_rejected(event: dict) -> None:
+    CHECKPOINTS_REJECTED.inc()
+
+
+def _measurement(event: dict, field: str):
+    """The event's measurement, or None when absent/non-numeric —
+    emit_event is a free-form API, and a malformed event must be
+    SKIPPED, not recorded as a fabricated 0.0 sample that drags every
+    percentile query down for the life of the process."""
+    value = event.get(field)
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def _on_serving_first_token(event: dict) -> None:
+    ttft_s = _measurement(event, "ttft_s")
+    if ttft_s is not None:
+        SERVING_TTFT.observe(ttft_s)
+
+
+def _on_serving_request_finished(event: dict) -> None:
+    per_token_ms = _measurement(event, "per_token_ms")
+    if per_token_ms is not None:
+        SERVING_PER_TOKEN.observe(per_token_ms / 1e3)
+    tokens_per_s = _measurement(event, "tokens_per_s")
+    if tokens_per_s is not None:
+        SERVING_TOKENS_PER_S.set(tokens_per_s)
+
+
+_HANDLERS = {
+    "retry_attempt": _on_retry_attempt,
+    "retry_exhausted": _on_retry_exhausted,
+    "batch_skipped": _on_batch_skipped,
+    "replica_desync": _on_replica_desync,
+    "supervisor_failure": _on_supervisor_failure,
+    "watchdog_stall": _on_watchdog_stall,
+    "fault_injected": _on_fault_injected,
+    "checkpoint_rejected": _on_checkpoint_rejected,
+    "serving_first_token": _on_serving_first_token,
+    "serving_request_finished": _on_serving_request_finished,
+}
+
+
+def _bridge_sink(event: dict) -> None:
+    kind = str(event.get("event", "unknown"))
+    EVENTS_TOTAL.inc(event=kind)
+    live = trace.current_span()
+    if live is not None:
+        live.add_event(kind)
+    handler = _HANDLERS.get(kind)
+    if handler is not None:
+        handler(event)
+
+
+def install() -> None:
+    """Subscribe the bridge sink (idempotent; on by default via
+    ``import apex_tpu.obs``)."""
+    _logging.add_event_sink(_bridge_sink)
+
+
+def uninstall() -> None:
+    """Unsubscribe the bridge sink (events stop feeding the registry;
+    already-accumulated series are untouched)."""
+    _logging.remove_event_sink(_bridge_sink)
+
+
+def installed() -> bool:
+    return _bridge_sink in _logging.event_sinks()

@@ -47,6 +47,8 @@ import jax
 import numpy as np
 
 from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs import trace as obs_trace
 from apex_tpu.utils.serialization import (
     is_prng_key,
     leaf_from_numpy,
@@ -72,6 +74,29 @@ _STEP_PREFIX = "step_"
 _TMP_PREFIX = "tmp_"
 _MANIFEST = "manifest.json"
 _DATA = "data.bin"
+
+_CKPT_SECONDS = obs_metrics.histogram(
+    "apex_checkpoint_duration_seconds",
+    "checkpoint operation wall time by op (save/validate/restore)",
+    ("op",))
+
+
+def _observed(op: str):
+    """Bracket a checkpoint entry point with a trace span and (on
+    success only — failed-attempt latencies would poison percentiles)
+    an ``apex_checkpoint_duration_seconds{op=...}`` observation."""
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = time.perf_counter()
+            with obs_trace.span(f"checkpoint_{op}"):
+                result = fn(*args, **kwargs)
+            _CKPT_SECONDS.observe(time.perf_counter() - t0, op=op)
+            return result
+        return wrapper
+    return deco
 
 
 class CheckpointError(RuntimeError):
@@ -195,6 +220,7 @@ def _rotate(root: str, keep: int, protect_step: int) -> None:
                           ignore_errors=True)
 
 
+@_observed("save")
 def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
     """Write ``tree`` as the step-``step`` checkpoint; returns its path.
 
@@ -356,6 +382,7 @@ def _quick_valid(ckpt_dir: str) -> bool:
         return False
 
 
+@_observed("validate")
 def validate_checkpoint(ckpt_dir: str) -> None:
     """Prove a checkpoint directory internally consistent.
 
@@ -450,6 +477,7 @@ def latest_valid_step(root: str) -> Optional[int]:
     return None
 
 
+@_observed("restore")
 def restore_checkpoint(root: str, like: Any, *,
                        step: Optional[int] = None) -> tuple[Any, int]:
     """Restore the newest *valid* checkpoint into ``like``'s structure.

@@ -66,6 +66,7 @@ from apex_tpu.resilience.checkpoint import (
     _commit_step_dir,
     _list_steps,
     _mesh_metadata,
+    _observed,
     _read_manifest,
     _rotate,
     _step_dirname,
@@ -173,6 +174,7 @@ def _spec_json(entries: Sequence[tuple[str, ...]]) -> list:
 # --------------------------------------------------------------------------
 
 
+@_observed("save")
 def save_sharded_checkpoint(root: str, step: int, tree: Any, *,
                             mesh: Optional[Mesh] = None,
                             specs: Any = None,
@@ -449,6 +451,7 @@ def _load_validated_sharded(ckpt_dir: str, like: Any) -> tuple[Any, int]:
     return jax.tree_util.tree_unflatten(treedef, leaves), manifest["step"]
 
 
+@_observed("restore")
 def restore_sharded_checkpoint(root: str, like: Any, *,
                                step: Optional[int] = None
                                ) -> tuple[Any, int]:

@@ -50,6 +50,8 @@ import time
 from typing import Any, Callable, Iterable, Optional, Tuple
 
 from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.obs import metrics as obs_metrics
+from apex_tpu.obs import trace as obs_trace
 from apex_tpu.resilience.checkpoint import (
     CheckpointError,
     CheckpointManager,
@@ -62,6 +64,7 @@ from apex_tpu.resilience.retry import (
     RetryPolicy,
     retry_transient,
 )
+from apex_tpu.utils.serialization import atomic_write_json
 
 __all__ = [
     "StepDeadlineExceeded",
@@ -74,6 +77,19 @@ __all__ = [
 ]
 
 logger = get_logger("resilience.supervisor")
+
+# hot-path instruments (docs/api/observability.md): the histogram is the
+# p99-step-time answer, the counter the progress rate, the gauge the
+# liveness probe an exporter reads WITHOUT parsing heartbeat files —
+# evaluated at scrape time via set_function, so it never goes stale
+_STEP_SECONDS = obs_metrics.histogram(
+    "apex_step_duration_seconds", "supervised train-step wall time")
+_STEPS_TOTAL = obs_metrics.counter(
+    "apex_supervisor_steps_total",
+    "steps completed under the training supervisor")
+_HEARTBEAT_AGE = obs_metrics.gauge(
+    "apex_heartbeat_age_seconds",
+    "seconds since the newest watchdog beat (-1 before the first)")
 
 
 class StepDeadlineExceeded(RuntimeError):
@@ -140,15 +156,11 @@ def write_heartbeat(path: str, step: int, *,
     except Exception as e:  # liveness probe must outlive rank plumbing
         logger.debug("heartbeat rank info unavailable: %s: %s",
                      type(e).__name__, e)
-    # thread ident in the temp name: the monitor thread (stall marker)
-    # and the main thread (beat) share a pid and may write concurrently —
-    # each needs its own temp file for os.replace to stay atomic
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    # atomic_write_json embeds the thread ident in its temp name: the
+    # monitor thread (stall marker) and the main thread (beat) share a
+    # pid and may write concurrently — each needs its own temp file for
+    # os.replace to stay atomic
+    atomic_write_json(path, payload)
     return payload
 
 
@@ -199,11 +211,32 @@ class StepWatchdog:
         self._last_ckpt_path: Optional[str] = None  # newest known checkpoint
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # scrape-time heartbeat age: the gauge binding is acquired at
+        # start() — NOT here, where merely constructing a second
+        # watchdog would steal the gauge from a healthy running one and
+        # report the -1 sentinel (a false wedged-host signal)
+        self._released = False
+        self._prev_beat_age: Optional[Callable[[], float]] = None
+
+    def _beat_age(self) -> float:
+        # a released (stopped) watchdog reports the no-live-beat
+        # sentinel, NEVER a frozen last beat aging without bound — even
+        # if a misordered stop() chain hands the gauge back to it
+        beat = self._last_beat if not self._released else None
+        return self._clock() - beat[1] if beat is not None else -1.0
 
     # -- monitor lifecycle -------------------------------------------------
 
     def start(self) -> "StepWatchdog":
-        """Spawn the monitor thread (idempotent)."""
+        """Spawn the monitor thread (idempotent).  Acquires (or
+        re-acquires after a stop()) the process-default heartbeat-age
+        gauge: the newest STARTED watchdog wins, the displaced binding
+        is remembered so stop() can hand it back, and a reused
+        supervisor's second run keeps its liveness probe."""
+        self._released = False
+        if _HEARTBEAT_AGE.bound_function() != self._beat_age:
+            self._prev_beat_age = _HEARTBEAT_AGE.bound_function()
+            _HEARTBEAT_AGE.set_function(self._beat_age)
         if self._thread is None or not self._thread.is_alive():
             self._stop.clear()
             self._thread = threading.Thread(
@@ -212,11 +245,26 @@ class StepWatchdog:
         return self
 
     def stop(self) -> None:
-        """Stop and join the monitor thread (idempotent)."""
+        """Stop and join the monitor thread (idempotent).  Also hands
+        the heartbeat-age gauge back IF still bound to this watchdog: a
+        finished run must not keep reporting an ever-growing age (a
+        false wedged-host signal) or pin this object alive through the
+        gauge's bound-method reference — and a short-lived inner
+        watchdog must not leave a still-running outer one unreported,
+        so the binding this one displaced at construction is restored
+        rather than cleared.  A newer watchdog's binding is left
+        untouched."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=max(self.poll_interval_s * 4, 1.0))
             self._thread = None
+        self._released = True
+        if _HEARTBEAT_AGE.bound_function() == self._beat_age:
+            _HEARTBEAT_AGE.set_function(self._prev_beat_age)
+            if self._prev_beat_age is None:
+                # keep the series PRESENT with the honest sentinel: an
+                # alert on -1 must read a sample, not a vanished series
+                _HEARTBEAT_AGE.set(-1.0)
 
     def __enter__(self) -> "StepWatchdog":
         return self.start()
@@ -538,73 +586,84 @@ class TrainingSupervisor:
         self.watchdog.start()
         try:
             while step < num_steps:
-                # -- fetch (retried; guard skips ride inside the iterator)
-                try:
-                    batch = self._next_batch(it)
-                except StopIteration:
-                    break
-                except self.FAILURE_DOMAIN as e:
-                    # state predates `step` (its fetch failed): any
-                    # emergency checkpoint must carry the completed label
-                    self.record_failure(step, state, e,
-                                        completed_step=last_completed)
-                    continue  # re-attempt the same step number
-
-                # -- the step itself, under the deadline
-                self.watchdog.arm(step)
-                try:
-                    new_state = step_fn(state, batch, step)
-                except BaseException:
-                    self.watchdog.cancel()  # not a deadline event
-                    raise
-                step_ok = True
-                try:
-                    self.watchdog.disarm()
-                except StepDeadlineExceeded as e:
-                    # late but finished: keep the result, count the miss
-                    step_ok = False
-                    self.record_failure(step, new_state, e)  # may abort
-                state = new_state
-                last_completed = step
-
-                # -- cross-replica consistency, BEFORE the checkpoint
-                # commit: a desynced state must never be persisted, and a
-                # resynced repair is what the periodic save should carry
-                if (self.consistency is not None
-                        and self.config.consistency_check_interval
-                        and (step + 1)
-                        % self.config.consistency_check_interval == 0):
+                # ONE span per step attempt, covering fetch -> step -> commit:
+                # fetch-retry and skip events stamp it, and the train_step +
+                # checkpoint_save spans nest inside — the trace of a slow
+                # step IS its causal story (docs recipe)
+                with obs_trace.span("supervisor_step", step=step):
+                    # -- fetch (retried; guard skips ride inside the iterator)
                     try:
-                        state = self.consistency.check(state, step=step)
-                        state_trusted = True  # proven clean (or repaired)
-                    except ReplicaDesyncError as e:
-                        # unrepaired divergence: one unrecovered failure
-                        # (escalates to emergency-checkpoint + abort at
-                        # the threshold, like every other failure kind);
-                        # commits are SKIPPED until a later pass proves
-                        # the state clean — it must not become
-                        # latest_valid_step and survive the restart
+                        batch = self._next_batch(it)
+                    except StopIteration:
+                        break
+                    except self.FAILURE_DOMAIN as e:
+                        # state predates `step` (its fetch failed): any
+                        # emergency checkpoint must carry the completed label
+                        self.record_failure(step, state, e,
+                                            completed_step=last_completed)
+                        continue  # re-attempt the same step number
+
+                    # -- the step itself, under the deadline
+                    self.watchdog.arm(step)
+                    t_step = time.perf_counter()
+                    try:
+                        with obs_trace.span("train_step", step=step):
+                            new_state = step_fn(state, batch, step)
+                    except BaseException:
+                        self.watchdog.cancel()  # not a deadline event
+                        raise
+                    # the step COMPLETED (possibly late): record its latency
+                    # unconditionally — the p99 answer must include stragglers
+                    _STEP_SECONDS.observe(time.perf_counter() - t_step)
+                    _STEPS_TOTAL.inc()
+                    step_ok = True
+                    try:
+                        self.watchdog.disarm()
+                    except StepDeadlineExceeded as e:
+                        # late but finished: keep the result, count the miss
                         step_ok = False
-                        state_trusted = False
-                        self.record_failure(step, state, e)
-                # the consecutive-failure counter resets only while the
-                # state is trusted — otherwise a desync that re-proves
-                # itself every interval would be buried by the
-                # intervening successful steps and never escalate
-                if step_ok and state_trusted:
-                    self.record_success()
+                        self.record_failure(step, new_state, e)  # may abort
+                    state = new_state
+                    last_completed = step
 
-                # -- commit host-side progress
-                ckpt_path = None
-                if self.manager is not None and state_trusted and (
-                        (step + 1) % self.config.checkpoint_every == 0
-                        or step + 1 >= num_steps):
-                    try:
-                        ckpt_path = self._checkpoint(step, state)
-                    except RetryExhausted as e:
-                        self.record_failure(step, state, e)  # may abort
-                self.watchdog.beat(step, ckpt_path=ckpt_path)
-                step += 1
+                    # -- cross-replica consistency, BEFORE the checkpoint
+                    # commit: a desynced state must never be persisted, and a
+                    # resynced repair is what the periodic save should carry
+                    if (self.consistency is not None
+                            and self.config.consistency_check_interval
+                            and (step + 1)
+                            % self.config.consistency_check_interval == 0):
+                        try:
+                            state = self.consistency.check(state, step=step)
+                            state_trusted = True  # proven clean (or repaired)
+                        except ReplicaDesyncError as e:
+                            # unrepaired divergence: one unrecovered failure
+                            # (escalates to emergency-checkpoint + abort at
+                            # the threshold, like every other failure kind);
+                            # commits are SKIPPED until a later pass proves
+                            # the state clean — it must not become
+                            # latest_valid_step and survive the restart
+                            step_ok = False
+                            state_trusted = False
+                            self.record_failure(step, state, e)
+                    # the consecutive-failure counter resets only while the
+                    # state is trusted — otherwise a desync that re-proves
+                    # itself every interval would be buried by the
+                    # intervening successful steps and never escalate
+                    if step_ok and state_trusted:
+                        self.record_success()
+
+                    # -- commit host-side progress
+                    ckpt_path = None
+                    if self.manager is not None and state_trusted and (
+                            (step + 1) % self.config.checkpoint_every == 0
+                            or step + 1 >= num_steps):
+                        try:
+                            ckpt_path = self._checkpoint(step, state)
+                        except RetryExhausted as e:
+                            self.record_failure(step, state, e)  # may abort
+                    self.watchdog.beat(step, ckpt_path=ckpt_path)
+                    step += 1
             return state, last_completed
         finally:
             self.watchdog.stop()

@@ -114,6 +114,8 @@ class DecodeEngine:
         if prefill_len > max_len:
             raise ValueError(f"prefill_len {prefill_len} > max_len "
                              f"{max_len}")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
         self.model = model
         self.params = params
         self.slots = int(slots)
@@ -178,6 +180,15 @@ class DecodeEngine:
 
     def free_slots(self) -> list[int]:
         return [i for i, n in enumerate(self._lengths_host) if n == 0]
+
+    def cache_utilization(self) -> float:
+        """Filled cache positions / total capacity, in ``[0, 1]`` — from
+        the host mirror, so sampling it every step costs no device sync.
+        The number an admission controller actually wants: slot
+        occupancy says how many streams are live, utilization says how
+        much of the preallocated KV memory their tokens fill."""
+        return float(self._lengths_host.sum()) / float(
+            self.slots * self.max_len)
 
     def _check_slot(self, slot: int) -> None:
         if not 0 <= slot < self.slots:

@@ -21,8 +21,12 @@ boundaries:
   (:mod:`apex_tpu._logging`) — ``serving_request_admitted`` /
   ``serving_first_token`` (time-to-first-token) /
   ``serving_request_finished`` (tokens/s, mean per-token latency) per
-  request, and a ``serving_step`` sample (queue depth, active slots)
-  every ``log_interval`` steps.
+  request, and a ``serving_step`` sample (queue depth, active slots,
+  slot occupancy, KV-cache utilization) every ``log_interval`` steps.
+  Current-state gauges (:mod:`apex_tpu.obs.bridge`:
+  ``apex_serving_queue_depth`` / ``apex_serving_slot_occupancy`` /
+  ``apex_serving_cache_utilization``) refresh every step, so a
+  Prometheus scrape sees live state regardless of ``log_interval``.
 
 Determinism: sampling draws from explicit per-request PRNG keys
 (``fold_in(PRNGKey(seed), token_index)``) — the clock feeds telemetry
@@ -41,6 +45,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.engine import DecodeEngine, request_key
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
@@ -279,10 +284,25 @@ class ContinuousBatchingScheduler:
                 if self._finish_if_done(st):
                     finished.append(st.request.rid)
         self._step_index += 1
+        # current-state gauges refresh EVERY step (a gauge tied to
+        # log_interval would be stale for interval-1 steps); occupancy
+        # and cache utilization ride the same sample so neither has to
+        # be inferred from the other
+        occupancy = len(self._active) / max(self.engine.slots, 1)
+        cache_util = self.engine.cache_utilization()
+        obs_bridge.SERVING_QUEUE_DEPTH.set(len(self._queue))
+        obs_bridge.SERVING_SLOT_OCCUPANCY.set(occupancy)
+        obs_bridge.SERVING_CACHE_UTILIZATION.set(cache_util)
+        # every step like the others (a cheap host-side jit-cache read):
+        # a scrape during the first log_interval steps must not read 0
+        # for a gauge documented as "1 == shape-stable"
+        obs_bridge.SERVING_DECODE_COMPILES.set(self.engine.decode_compiles())
         if self._step_index % self.log_interval == 0:
             emit_event("serving_step", step=self._step_index,
                        queue_depth=len(self._queue),
-                       active_slots=len(self._active))
+                       active_slots=len(self._active),
+                       slot_occupancy=round(occupancy, 4),
+                       cache_utilization=round(cache_util, 6))
         return finished
 
     def run(self, max_steps: Optional[int] = None

@@ -123,6 +123,20 @@ class Timers:
             out[name] = {"total_s": round(total, 6), "running": running}
         return out
 
+    def publish_metrics(self) -> Dict[str, dict]:
+        """Export the (non-destructive) :meth:`snapshot` totals as the
+        ``apex_timer_seconds{region=...}`` gauge series in the default
+        observability registry — every timed region becomes a scrapeable
+        cumulative-seconds gauge, the per-region analog of the step-time
+        histogram.  Returns the snapshot it published.  The import is
+        lazy so this module stays importable without the obs layer."""
+        from apex_tpu.obs.bridge import TIMER_SECONDS
+
+        snap = self.snapshot()
+        for name, rec in snap.items():
+            TIMER_SECONDS.set(rec["total_s"], region=name)
+        return snap
+
     def write(self, names: List[str], writer, iteration: int,
               normalizer: float = 1.0, reset: bool = False) -> None:
         """Export per-name mean seconds to any ``add_scalar(tag, val, step)``

@@ -17,11 +17,56 @@ Typed PRNG keys (``jax.random.key``) are stored as their raw
 
 from __future__ import annotations
 
+import json
+import os
+import threading
 import zlib
 from typing import Any
 
 import jax
 import numpy as np
+
+
+def atomic_write_json(path: str, payload: Any, **dump_kwargs) -> None:
+    """Crash-safe JSON rewrite: temp file + ``fsync`` + ``os.replace``.
+
+    The temp name embeds pid AND thread id, so concurrent writers (the
+    watchdog monitor thread marking a stall while the main thread
+    beats) never share a temp file and every rename stays atomic.  One
+    helper for every small-JSON writer in the tree — heartbeats, metric
+    snapshots, trace exports.
+    """
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f, **dump_kwargs)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # an unserializable payload must not litter half-written temp
+        # files next to checkpoints on every failed export
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def json_finite(obj: Any) -> Any:
+    """Deep-copy with non-finite floats replaced by None (JSON has no
+    NaN/Inf; a strict parser must never choke on an exported snapshot).
+    Tuples/sets normalize to lists — ``json.dump`` serializes them
+    natively, so a NaN nested in a tuple would otherwise slip past this
+    walk straight into ``allow_nan=False``'s raise.  Shared by the
+    metrics and trace exporters."""
+    if isinstance(obj, float):
+        return obj if -float("inf") < obj < float("inf") else None
+    if isinstance(obj, dict):
+        return {k: json_finite(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set, frozenset)):
+        return [json_finite(v) for v in obj]
+    return obj
 
 
 def np_dtype(name: str) -> np.dtype:

@@ -192,10 +192,11 @@ def _run_external(name: str, *, batch, steps, seq) -> dict:
 
 # Diagnostic blocks riding every captured config: ``recovery`` (checkpoint
 # save/validate/restore on the live train state, below), ``supervisor``
-# (_supervisor_metrics: watchdog arm/disarm, heartbeat write, retry path)
-# and ``elastic`` (_elastic_metrics: sharded save + dp 4->2->8 reshard
-# restore, replica-hash verify) keep the robustness tax visible in the
-# BENCH trajectory.
+# (_supervisor_metrics: watchdog arm/disarm, heartbeat write, retry path),
+# ``elastic`` (_elastic_metrics: sharded save + dp 4->2->8 reshard
+# restore, replica-hash verify) and ``obs`` (_obs_metrics: metric-update
+# ns/op, span enter/exit ns, exposition ms at 1k series) keep the
+# robustness+observability tax visible in the BENCH trajectory.
 
 # resilience-overhead capture: checkpointing the full 774M train state
 # (~9 GB with optimizer moments) through the tunnel would dominate the
@@ -484,6 +485,75 @@ def _serving_metrics(*, decode_tokens: int = 48, prompt_len: int = 5,
     }
 
 
+def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
+    """Observability tax of the ISSUE-6 layer (the BENCH_*.json ``obs``
+    block): per-update cost of each instrument kind, span enter/exit
+    cost with and without a recorder attached, and Prometheus text
+    exposition latency at ``n_series`` label series.  A PRIVATE registry
+    is used throughout so the bench never pollutes the process-default
+    one the instrumented subsystems share."""
+    from apex_tpu.obs import metrics as om
+    from apex_tpu.obs import trace as ot
+
+    reg = om.MetricsRegistry()
+    c = reg.counter("apex_bench_incs_total", "bench-only")
+    g = reg.gauge("apex_bench_depth", "bench-only")
+    h = reg.histogram("apex_bench_lat_seconds", "bench-only")
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        c.inc()
+    counter_ns = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for i in range(n):
+        g.set(i)
+    gauge_ns = (time.perf_counter() - t0) / n * 1e9
+    t0 = time.perf_counter()
+    for _ in range(n):
+        h.observe(3.7e-3)
+    hist_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # span cost with NO recorder — the always-on hot-path price (the
+    # bench must measure the real default, so park any installed one)
+    prev = ot.uninstall_recorder()
+    try:
+        n_span = max(n // 5, 1)
+        t0 = time.perf_counter()
+        for _ in range(n_span):
+            with ot.span("bench"):
+                pass
+        span_off_ns = (time.perf_counter() - t0) / n_span * 1e9
+        n_rec = max(n // 50, 1)
+        with ot.recording():
+            t0 = time.perf_counter()
+            for i in range(n_rec):
+                with ot.span("bench", i=i):
+                    pass
+            span_on_ns = (time.perf_counter() - t0) / n_rec * 1e9
+    finally:
+        if prev is not None:
+            ot.install_recorder(prev)
+
+    lc = reg.counter("apex_bench_series_total", "bench-only", ("k",))
+    for i in range(n_series):
+        lc.inc(k=f"s{i:04d}")
+    t0 = time.perf_counter()
+    text = reg.prometheus_text()
+    exposition_ms = (time.perf_counter() - t0) * 1e3
+    assert f'k="s{n_series - 1:04d}"' in text
+
+    return {
+        "ok": True,
+        "counter_inc_ns": round(counter_ns, 1),
+        "gauge_set_ns": round(gauge_ns, 1),
+        "histogram_observe_ns": round(hist_ns, 1),
+        "span_ns_no_recorder": round(span_off_ns, 1),
+        "span_ns_recording": round(span_on_ns, 1),
+        "exposition_ms": round(exposition_ms, 3),
+        "exposition_series": n_series,
+    }
+
+
 def run_config(name: str, *, batch: int | None = None,
                steps: int | None = None, seq: int | None = None) -> dict:
     """Build everything from scratch, run the timing protocol, return the
@@ -641,6 +711,10 @@ def run_config(name: str, *, batch: int | None = None,
         serving = _serving_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         serving = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        obs = _obs_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -655,6 +729,7 @@ def run_config(name: str, *, batch: int | None = None,
         "supervisor": supervisor,
         "elastic": elastic,
         "serving": serving,
+        "obs": obs,
         "config": out_cfg,
     }
 

@@ -22,7 +22,7 @@ import argparse
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.optimizers import FusedLAMB
@@ -106,7 +106,7 @@ def main():
         step = jax.jit(shard_map(
             train_step, mesh=mesh,
             in_specs=(P(), P(), P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
-            out_specs=(P(), P(), P()), check_vma=False))
+            out_specs=(P(), P(), P()), **NO_REP_CHECK))
         first = last = None
         for it in range(args.steps):
             params, opt_state, loss = step(params, opt_state, *batch0)

@@ -26,7 +26,7 @@ import sys
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import PartitionSpec as P
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -128,10 +128,10 @@ def main(args_list=None):
         batches0 = synth_batches()
         params, opt_state = jax.jit(shard_map(
             init_fn, mesh=mesh, in_specs=(batch_specs,), out_specs=P(),
-            check_vma=False))(batches0)
+            **NO_REP_CHECK))(batches0)
         step = jax.jit(shard_map(
             train_step, mesh=mesh, in_specs=(P(), P(), batch_specs),
-            out_specs=(P(), P(), P()), check_vma=False))
+            out_specs=(P(), P(), P()), **NO_REP_CHECK))
 
         iters = args.train_iters or 10
         consumed = 0

@@ -27,7 +27,7 @@ import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -140,7 +140,7 @@ def main():
             train_step, mesh=mesh,
             in_specs=(pspecs, ospecs, P("dp")),
             out_specs=(pspecs, ospecs, P()),
-            check_vma=False))
+            **NO_REP_CHECK))
         first = last = None
         for it in range(args.steps):
             params, opt_state, loss = step(params, opt_state, batch0)
@@ -199,10 +199,10 @@ def main_3d(args):
         params, opt_state = jax.jit(shard_map(
             functools.partial(init_fn, jax.random.PRNGKey(args.seed)),
             mesh=mesh, in_specs=(batch_specs,), out_specs=P(),
-            check_vma=False))(batches)
+            **NO_REP_CHECK))(batches)
         step = jax.jit(shard_map(
             train_step, mesh=mesh, in_specs=(P(), P(), batch_specs),
-            out_specs=(P(), P(), P()), check_vma=False))
+            out_specs=(P(), P(), P()), **NO_REP_CHECK))
         first = last = None
         for it in range(args.steps):
             params, opt_state, loss = step(params, opt_state, batches)

@@ -8,7 +8,8 @@ REPO = Path(__file__).resolve().parent.parent
 DOCS = REPO / "docs"
 
 PAGES = ["amp", "optimizers", "parallel", "transformer", "normalization",
-         "layers", "ops", "models", "contrib", "resilience", "utils"]
+         "layers", "ops", "models", "contrib", "resilience", "serving",
+         "observability", "utils"]
 
 # page -> symbols a user would look up there (spot checks that the
 # generator actually rendered the module contents, not empty shells)
@@ -25,6 +26,15 @@ MUST_MENTION = {
     "models": ["LlamaForCausalLM", "ViTConfig", "build_llama_pipeline",
                "vit_l16", "llama2_7b"],
     "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
+    "serving": ["DecodeEngine", "ContinuousBatchingScheduler",
+                "load_serving_params", "cache_utilization"],
+    # the prologue (naming conventions + metric inventory + span
+    # semantics) plus the introspected API must both be present
+    "observability": ["MetricsRegistry", "Histogram", "prometheus_text",
+                      "TraceRecorder", "recording", "profile_on_stall",
+                      "apex_step_duration_seconds", "apex_serving_ttft_seconds",
+                      "add_event_sink", "LATENCY_BUCKETS_S", "le=",
+                      "traceEvents"],
     # the prologue (checkpoint format / recovery semantics / supervisor
     # sections) plus the introspected API must both be present
     "resilience": ["CheckpointManager", "FaultInjector", "make_guarded_step",

@@ -1,0 +1,98 @@
+"""tools/check_metrics.py wired into tier-1: every metric under
+``apex_tpu/`` keeps the naming conventions, is registered at exactly one
+call site, and is documented in docs/api/observability.md (ISSUE 6
+satellite)."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import check_metrics  # noqa: E402
+
+
+def test_repo_metrics_are_clean():
+    problems = check_metrics.find_violations()
+    assert problems == [], (
+        "metric lint violations (fix the name, de-duplicate the "
+        "registration, or document it): " + "\n".join(problems))
+
+
+def test_every_registered_runtime_metric_is_collected_by_the_lint():
+    """The static scan must see at least every metric the default
+    registry actually holds after the instrumented subsystems import —
+    a registration path the lint can't see would be unlintable."""
+    import apex_tpu.resilience.supervisor  # noqa: F401 — registers metrics
+    import apex_tpu.serving.scheduler  # noqa: F401
+    from apex_tpu.obs import REGISTRY
+
+    static_names = {r.name for r in check_metrics.collect()}
+    for name in REGISTRY.names():
+        assert name in static_names, (
+            f"runtime metric {name!r} not found by the static scan")
+
+
+# ---- seeded-violation unit tests (the lint must actually bite) ----------
+
+def _check_src(source: str, doc: str | None = "") -> list:
+    regs = check_metrics.collect_from_source(source, "sample.py")
+    return check_metrics.check(regs, doc)
+
+
+def test_lint_flags_bad_names():
+    problems = _check_src(
+        'c = metrics.counter("step_total", "no apex_ prefix")\n'
+        'g = metrics.gauge("apex_BadCase", "uppercase")\n')
+    assert len(problems) == 2
+    assert "does not match" in problems[0]
+    assert "does not match" in problems[1]
+
+
+def test_lint_flags_missing_suffixes():
+    problems = _check_src(
+        'c = metrics.counter("apex_things", "counter sans _total")\n'
+        'h = metrics.histogram("apex_latency", "histogram sans unit")\n')
+    assert any("_total" in p for p in problems)
+    assert any("unit" in p for p in problems)
+
+
+def test_lint_flags_duplicate_registration():
+    problems = _check_src(
+        'a = metrics.counter("apex_dups_total", "one")\n'
+        'b = reg.counter("apex_dups_total", "two")\n')
+    assert any("2 call sites" in p for p in problems)
+
+
+def test_lint_documentation_match_is_word_bounded():
+    """A name that is a prefix of a documented name is still
+    undocumented — substring containment must not pass it."""
+    problems = _check_src(
+        'c = metrics.gauge("apex_serving_tokens", "prefix of a real one")\n',
+        doc="inventory: apex_serving_tokens_per_second")
+    assert any("not documented" in p for p in problems)
+
+
+def test_lint_flags_undocumented_and_missing_doc():
+    problems = _check_src(
+        'c = metrics.counter("apex_ghost_total", "undocumented")\n',
+        doc="some page that never mentions it")
+    assert any("not documented" in p for p in problems)
+    problems = _check_src(
+        'c = metrics.counter("apex_ghost_total", "undocumented")\n',
+        doc=None)
+    assert any("missing" in p for p in problems)
+
+
+def test_lint_accepts_clean_registration():
+    assert _check_src(
+        'c = metrics.counter("apex_good_total", "fine")\n'
+        'h = metrics.histogram("apex_lat_seconds", "fine")\n'
+        'g = metrics.gauge("apex_depth", "fine")\n',
+        doc="apex_good_total apex_lat_seconds apex_depth") == []
+
+
+def test_lint_ignores_non_literal_and_unrelated_calls():
+    regs = check_metrics.collect_from_source(
+        'x = registry.counter(name_var, "dynamic: out of scope")\n'
+        'y = collections.Counter([1, 2])\n'
+        'z = obj.histogram()\n', "sample.py")
+    assert regs == []

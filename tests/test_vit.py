@@ -117,8 +117,9 @@ def test_train_step_runs(rng):
 
 def test_tensor_parallel_matches_single(devices, rng):
     """tp=2 sharded logits == unsharded logits."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 
     from apex_tpu.transformer import parallel_state
 
@@ -146,7 +147,7 @@ def test_tensor_parallel_matches_single(devices, rng):
             out = jax.jit(shard_map(
                 lambda p, x: model.apply(p, x), mesh=mesh,
                 in_specs=(specs, P()), out_specs=P(),
-                check_vma=False))(params, pixels)
+                **NO_REP_CHECK))(params, pixels)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
     finally:

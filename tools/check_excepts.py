@@ -32,11 +32,13 @@ SCAN = ("apex_tpu", "tools", "examples", "bench.py")
 # "relpath::qualname" of handlers audited and accepted as-is.  Every
 # entry must keep matching a real broad-and-silent handler — a stale
 # entry fails the lint too, so the list can only shrink or be
-# consciously re-justified.  Last audited with ISSUE 4 (the serving
-# subsystem lands lint-clean: kv_cache/engine/scheduler/weights have no
-# broad handlers at all — every failure raises a typed error or rides a
-# structured event — and bench's serving block uses the same logged
-# `except Exception` pattern as the other diagnostic blocks).
+# consciously re-justified.  Last audited with ISSUE 6 (apex_tpu/obs/
+# lands lint-clean: the emit_event sink fan-out, gauge set_function
+# evaluation, and the jax-profiler hooks all debug/warning-log their
+# swallowed failures — no entry needed; ISSUE 4's audit note: serving
+# has no broad handlers at all, and bench's serving/obs blocks use the
+# same logged `except Exception` pattern as the other diagnostic
+# blocks).
 ALLOWLIST = {
     # availability probes: False/None IS the complete answer
     "apex_tpu/feature_registry.py::on_tpu",

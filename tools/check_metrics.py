@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Lint: metric naming, single registration, and documentation.
+
+A metrics namespace rots in three ways: names that don't parse as one
+family (``stepTime`` next to ``apex_step_seconds``), the same name
+registered from two call sites (two definitions silently split one
+series — the runtime registry raises only when signatures *conflict*),
+and metrics that exist in code but not in the reference page (operators
+alert on what they can look up).  This lint pins all three statically:
+
+1. every literal metric name passed to a ``counter(`` / ``gauge(`` /
+   ``histogram(`` call under ``apex_tpu/`` matches ``^apex_[a-z0-9_]+$``;
+2. counters end in ``_total`` and histograms carry a unit suffix
+   (``_seconds`` / ``_bytes``) — the Prometheus conventions the docs
+   promise;
+3. each name is registered at exactly ONE call site (declare the
+   instrument once at module level, import the object everywhere else);
+4. each name appears in ``docs/api/observability.md`` (regenerate via
+   ``tools/gen_api_docs.py`` after editing its PAGE_PROLOGUE table).
+
+Run directly (``python tools/check_metrics.py``) or through tier-1
+(``tests/test_lint_metrics.py``).  Scope is ``apex_tpu/`` only: tests
+and bench harnesses register into private registries with their own
+throwaway names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, NamedTuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN = ("apex_tpu",)
+DOC = os.path.join(REPO, "docs", "api", "observability.md")
+
+_METRIC_FUNCS = {"counter", "gauge", "histogram"}
+_NAME_RE = re.compile(r"^apex_[a-z0-9_]+$")
+_UNIT_SUFFIXES = ("_seconds", "_bytes")
+
+
+class Registration(NamedTuple):
+    name: str       # the metric name literal
+    kind: str       # counter | gauge | histogram
+    relpath: str
+    lineno: int
+
+
+def _call_kind(node: ast.Call) -> str | None:
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in _METRIC_FUNCS:
+        return func.id
+    if isinstance(func, ast.Attribute) and func.attr in _METRIC_FUNCS:
+        return func.attr
+    return None
+
+
+def collect_from_source(source: str, relpath: str) -> List[Registration]:
+    """Every ``counter/gauge/histogram`` call whose first argument is a
+    string literal.  A non-literal first argument (a variable) is out of
+    scope — none exist in-tree, and dynamic names can't be linted."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        # surface as a bogus registration so the lint fails loudly
+        return [Registration(f"<syntax error: {e.msg}>", "error",
+                             relpath, e.lineno or 0)]
+    out: List[Registration] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_kind(node)
+        if kind is None or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            out.append(Registration(first.value, kind, relpath,
+                                    first.lineno))
+    return out
+
+
+def _iter_files():
+    for entry in SCAN:
+        full = os.path.join(REPO, entry)
+        for dirpath, _, filenames in os.walk(full):
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+
+def collect() -> List[Registration]:
+    regs: List[Registration] = []
+    for path in _iter_files():
+        with open(path) as f:
+            source = f.read()
+        regs.extend(collect_from_source(source,
+                                        os.path.relpath(path, REPO)))
+    return regs
+
+
+def check(regs: List[Registration], doc_text: str | None) -> List[str]:
+    """All violations as human-readable messages (empty == clean)."""
+    problems: List[str] = []
+    by_name: dict[str, List[Registration]] = {}
+    for r in regs:
+        by_name.setdefault(r.name, []).append(r)
+        where = f"{r.relpath}:{r.lineno}"
+        if r.kind == "error":
+            problems.append(f"{where}: {r.name}")
+            continue
+        if not _NAME_RE.match(r.name):
+            problems.append(
+                f"{where}: metric name {r.name!r} does not match "
+                f"{_NAME_RE.pattern}")
+            continue
+        if r.kind == "counter" and not r.name.endswith("_total"):
+            problems.append(
+                f"{where}: counter {r.name!r} must end in _total")
+        if r.kind == "histogram" and not r.name.endswith(_UNIT_SUFFIXES):
+            problems.append(
+                f"{where}: histogram {r.name!r} must carry a unit "
+                f"suffix {_UNIT_SUFFIXES}")
+    for name, sites in sorted(by_name.items()):
+        if len(sites) > 1:
+            locs = ", ".join(f"{s.relpath}:{s.lineno}" for s in sites)
+            problems.append(
+                f"metric {name!r} registered at {len(sites)} call sites "
+                f"({locs}) — declare once, import the object")
+    if doc_text is None:
+        problems.append(
+            f"missing {os.path.relpath(DOC, REPO)} — run "
+            f"tools/gen_api_docs.py (every metric must be documented)")
+    else:
+        for name in sorted(by_name):
+            # word-bounded: `apex_serving_tokens` must NOT pass just
+            # because `apex_serving_tokens_per_second` is documented
+            if _NAME_RE.match(name) and not re.search(
+                    rf"\b{re.escape(name)}\b(?![a-z0-9_])", doc_text):
+                problems.append(
+                    f"metric {name!r} is not documented in "
+                    f"{os.path.relpath(DOC, REPO)} (add it to the "
+                    f"inventory table in gen_api_docs.py PAGE_PROLOGUE "
+                    f"and regenerate)")
+    return problems
+
+
+def find_violations() -> List[str]:
+    doc_text = None
+    if os.path.exists(DOC):
+        with open(DOC) as f:
+            doc_text = f.read()
+    return check(collect(), doc_text)
+
+
+def main() -> int:
+    problems = find_violations()
+    for p in problems:
+        print(p)
+    if not problems:
+        print(f"metrics lint clean ({len(collect())} registrations)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -92,6 +92,10 @@ PAGES = {
         "apex_tpu.serving.engine", "apex_tpu.serving.scheduler",
         "apex_tpu.serving.weights",
     ]),
+    "observability": ("Observability (metrics, spans, exporters)", [
+        "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
+        "apex_tpu.obs.bridge",
+    ]),
     "utils": ("Utilities", [
         "apex_tpu.utils.nvtx", "apex_tpu.utils.packing",
         "apex_tpu.utils.serialization", "apex_tpu.utils.compat",
@@ -421,6 +425,104 @@ prefill tokens/s, steady-state decode ms/token, and continuous-batching
 aggregate throughput at 1/4/8 concurrent streams with staggered
 arrivals (4 concurrent streams ≥ 2× four sequential runs).
 """,
+    "observability": """\
+Answer "what is my p99 step time, queue depth, or TTFT right now"
+in-process: a dependency-free metrics registry + span tracer that the
+training supervisor, checkpoint manager, serving scheduler/engine and
+pipeline timers all publish into, with Prometheus text / JSON / Chrome
+trace-event exporters.  Every path below runs under tier-1
+(`tests/test_obs.py`), including fault-injected counter-exactness runs
+for both training and serving.
+
+## Metric naming conventions
+
+Enforced at registration (`obs.metrics`) **and** statically by
+`tools/check_metrics.py` (tier-1: `tests/test_lint_metrics.py`):
+
+- every name matches `^apex_[a-z0-9_]+$`;
+- counters end in `_total`; histograms carry a unit suffix
+  (`_seconds` / `_bytes`); gauges are free-form;
+- each name is registered at exactly **one** call site (declare the
+  instrument once at module level, import the object everywhere else);
+- each name appears in the inventory below (the lint cross-checks this
+  page, so the table cannot rot).
+
+Label names match `[a-z_][a-z0-9_]*`; keep cardinality bounded (label
+by event kind or call site, never by request id or step number).
+Histograms default to fixed log-spaced latency buckets
+(`LATENCY_BUCKETS_S`: 4/decade, 100 µs – 100 s) so two processes — or
+two rounds of a benchmark — aggregate bucket-to-bucket.
+
+## Metric inventory
+
+| Metric | Kind | Source |
+|---|---|---|
+| `apex_events_total{event}` | counter | every `emit_event`, via the bridge |
+| `apex_step_duration_seconds` | histogram | supervisor step loop |
+| `apex_supervisor_steps_total` | counter | supervisor step loop |
+| `apex_heartbeat_age_seconds` | gauge (scrape-time fn) | step watchdog (−1 before the first beat) |
+| `apex_supervisor_failures_total{failure}` | counter | `supervisor_failure` events |
+| `apex_watchdog_stalls_total` | counter | `watchdog_stall` events |
+| `apex_retry_attempts_total{what}` | counter | `retry_attempt` events |
+| `apex_retry_exhausted_total{what}` | counter | `retry_exhausted` events |
+| `apex_batches_skipped_total` | counter | `batch_skipped` events |
+| `apex_replica_desync_total` | counter | `replica_desync` events |
+| `apex_faults_injected_total{fault}` | counter | `fault_injected` events |
+| `apex_checkpoint_duration_seconds{op}` | histogram | save/validate/restore wall time |
+| `apex_checkpoints_rejected_total` | counter | `checkpoint_rejected` events |
+| `apex_serving_ttft_seconds` | histogram | `serving_first_token` events |
+| `apex_serving_decode_per_token_seconds` | histogram | `serving_request_finished` events |
+| `apex_serving_tokens_per_second` | gauge | last finished request |
+| `apex_serving_queue_depth` | gauge | scheduler, every step |
+| `apex_serving_slot_occupancy` | gauge | scheduler, every step |
+| `apex_serving_cache_utilization` | gauge | `DecodeEngine.cache_utilization()`, every step |
+| `apex_serving_decode_compiles` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
+| `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
+
+## Exposition formats
+
+`prometheus_text()` renders the Prometheus text format (0.0.4),
+deterministically ordered: `# HELP` / `# TYPE` headers, one sample per
+labeled series, histograms as cumulative `_bucket{le=...}` +
+`_sum`/`_count`.  Serve it from any HTTP handler or dump it for a
+node-exporter textfile collector.  `write_json(path)` atomically
+(temp + `os.replace`) writes `{"time": ..., "metrics": snapshot()}`;
+`snapshot()` is the structured point-in-time read tests assert against.
+Updates are thread-safe; with no exporter attached the per-update cost
+is one lock + one dict write (`bench.py`'s `obs` block pins
+counter-inc/gauge-set/histogram-observe ns/op and exposition ms at 1k
+series).
+
+## Span semantics
+
+`with span("train_step", step=i) as s:` times a region on the
+**monotonic** clock.  With no recorder installed the span is a
+near-no-op (one global read — the always-on default).  Under
+`install_recorder()` / `with recording() as rec:` each span records a
+Chrome trace-event `"X"` entry (`ts`/`dur` in µs, `pid`/`tid`, `args`
+carrying attributes + `span_id`/`parent_id`); parent linkage rides
+contextvars, so nesting is lexical per thread and survives
+context-copying executors.  `current_span()` exposes the innermost live
+span — the event bridge stamps every `emit_event` kind onto it, so a
+trace of a slow step shows the retries/skips that fired inside it.
+`rec.to_chrome_trace()` / `rec.export(path)` produce the
+`{"traceEvents": [...]}` JSON that `chrome://tracing` and
+[Perfetto](https://ui.perfetto.dev) load directly.  For device-side truth, `start_jax_profiler(logdir)` /
+`stop_jax_profiler()` wrap `jax.profiler`, and
+`profile_on_stall(logdir)` adapts them to `StepWatchdog(on_stall=...)`
+so the first stall of a run captures a device profile on demand.
+
+## The event bridge
+
+`apex_tpu._logging.emit_event` fans out to a sink registry
+(`add_event_sink` / `remove_event_sink`); the default sink is the
+original JSON log line — **byte-identical** with or without extra
+sinks.  `obs.bridge` (installed when `apex_tpu.obs` imports, which
+every instrumented subsystem does) subscribes a sink that counts every
+event kind, stamps the active span, and runs per-kind handlers for
+payloads carrying real measurements.  Zero call-site churn: existing
+`emit_event` callers became metrics sources without edits.
+""",
 }
 
 
@@ -669,6 +771,43 @@ on EOS/max-tokens with immediate reuse; the decode step compiles once
 and never retraces, no matter how requests arrive.  Greedy decode
 through the cache is bit-identical to the uncached forward (the tier-1
 acceptance test), and sampling replays exactly from its explicit seeds.
+
+Watch a training job live — the supervisor, checkpoint manager, and
+serving scheduler already publish into the default metrics registry
+(every `emit_event` increments a counter via the sink bridge; step
+latency, checkpoint durations, TTFT and queue depth are first-class
+series), so observing a run is export-only
+([full page](api/observability.md)):
+
+```python
+from apex_tpu import obs
+
+# 1. metrics: scrape or dump — no server required
+print(obs.prometheus_text())          # Prometheus text exposition
+obs.write_json("/ckpts/run7/metrics.json")   # atomic JSON snapshot
+hist = obs.REGISTRY.get("apex_step_duration_seconds")
+print(hist.count(), hist.sum())       # step count + total seconds
+
+# 2. spans: record a window, open it in Perfetto (ui.perfetto.dev)
+rec = obs.install_recorder()
+state, last = sup.run(step_fn, state, batches, num_steps=n)
+obs.uninstall_recorder()
+rec.export("/ckpts/run7/trace.json")  # chrome://tracing-loadable
+
+# 3. a stall? capture a device profile the moment it happens (opt-in)
+wd = rz.StepWatchdog(deadline_s=120.0,
+                     on_stall=obs.profile_on_stall("/ckpts/run7/prof"))
+```
+
+Every step is ONE `supervisor_step` span covering fetch → step →
+commit: fetch retries and batch skips stamp it as events, and the
+`train_step` and `checkpoint_save` spans nest inside it — the trace of
+a slow step is also its causal story.  `apex_heartbeat_age_seconds`
+evaluates at scrape time, so a wedged host shows a growing age, not a
+stale sample (a stopped watchdog reports the `-1` no-live-beat
+sentinel).  With
+no exporter attached the whole layer costs a lock + dict write per
+update (`bench.py` `obs` block).
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -103,21 +103,25 @@ def _rope_freqs(s: int, dim: int, theta: float, offset=0) -> jax.Array:
     return jnp.concatenate([f, f], axis=-1)[:, None, None, :]  # [s,1,1,d]
 
 
-# single-token decode pads its query block to this many (identical) rows:
+# cached-attention query blocks are padded to at least this many rows:
 # XLA-CPU lowers an M=1 score "matmul" as a gemv whose per-element rounding
 # differs from the gemm the uncached forward's [s, s] scores go through;
-# M=8 keeps both paths in the gemm regime so the dot products round
+# M>=8 keeps both paths in the gemm regime so the dot products round
 # identically (pinned by tests/test_serving.py bit-parity)
 _DECODE_QPAD = 8
 
 
-def _decode_attention(qt, kt, vt, position):
+def _cached_attention(qt, kt, vt, bounds):
     """Length-masked attention read over a full KV-cache buffer.
 
-    ``qt``: ``[b, h, 1, hd]`` (the current token per slot); ``kt``/``vt``:
-    ``[b, h, max_len, hd]`` (the cache, GQA-expanded); ``position``:
-    ``[b]`` — index of each slot's current token (``idx <= position`` is
-    visible, everything past it is masked garbage).
+    ``qt``: ``[b, h, m, hd]`` query rows; ``kt``/``vt``: ``[b, h,
+    max_len, hd]`` (the cache, GQA-expanded); ``bounds``: ``[b, m]``
+    int32 — row ``i`` of batch element ``b`` attends cache positions
+    ``idx <= bounds[b, i]``; everything past its bound is masked
+    garbage.  Two callers: single-token decode (``m == 1``, one bound
+    per slot) and chunked prefill (``m == chunk``, ``bounds[0, i] =
+    offset + i`` — the chunk's causal block over the previously cached
+    context).
 
     The op sequence mirrors ``ops.flash_attention.mha_reference`` (scale
     folded into fp32 q before the dot, ``-1e30`` mask, max/exp/sum/divide,
@@ -128,28 +132,47 @@ def _decode_attention(qt, kt, vt, position):
     and the parity acceptance test in one property).
     """
     from apex_tpu.ops.flash_attention import _NEG_INF
-    from apex_tpu.serving.kv_cache import valid_token_mask
 
-    b, h, _, hd = qt.shape
+    b, h, m, hd = qt.shape
     max_len = kt.shape[2]
     scale = 1.0 / hd ** 0.5
-    qp = jnp.broadcast_to(qt, (b, h, _DECODE_QPAD, hd))
+    mp = max(m, _DECODE_QPAD)
+    if m < mp:
+        # pad the query block with copies of its last row (same bound):
+        # the extra rows are sliced off below, and per-row results are
+        # M-extent-invariant in the gemm regime, so padding never moves
+        # a real row's bits
+        qt = jnp.concatenate(
+            [qt, jnp.broadcast_to(qt[:, :, -1:], (b, h, mp - m, hd))],
+            axis=2)
+        bounds = jnp.concatenate(
+            [bounds, jnp.broadcast_to(bounds[:, -1:], (b, mp - m))],
+            axis=1)
     s = jax.lax.dot_general(
-        qp.astype(jnp.float32) * scale, kt.astype(jnp.float32),
-        (((3,), (3,)), ((0, 1), (0, 1))))          # [b, h, QPAD, max]
+        qt.astype(jnp.float32) * scale, kt.astype(jnp.float32),
+        (((3,), (3,)), ((0, 1), (0, 1))))          # [b, h, mp, max]
     # masked scores sit at the flash kernels' exact _NEG_INF: exp of the
     # masked residual underflows to exactly 0.0 in f32, which is what
     # makes these fixed-extent reductions bit-exact vs a same-extent
     # uncached forward
-    valid = valid_token_mask(position, max_len)
-    s = jnp.where(valid[:, None, None, :], s, _NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    e = jnp.exp(s - m)
+    idx = jnp.arange(max_len, dtype=jnp.int32)
+    valid = idx[None, None, :] <= bounds[:, :, None]   # [b, mp, max]
+    s = jnp.where(valid[:, None], s, _NEG_INF)
+    mx = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - mx)
     l = jnp.sum(e, axis=-1, keepdims=True)
     p = e / l
     out = jax.lax.dot_general(p, vt.astype(jnp.float32),
                               (((3,), (2,)), ((0, 1), (0, 1))))
-    return out[:, :, :1].astype(qt.dtype)           # [b, h, 1, hd]
+    return out[:, :, :m].astype(qt.dtype)           # [b, h, m, hd]
+
+
+def _decode_attention(qt, kt, vt, position):
+    """Single-token cached read: ``qt [b, h, 1, hd]``, one visibility
+    bound per slot (``idx <= position[b]``).  See
+    :func:`_cached_attention` for the masking/bit-exactness contract."""
+    return _cached_attention(qt, kt, vt,
+                             jnp.asarray(position, jnp.int32)[:, None])
 
 
 class LlamaMLP(nn.Module):
@@ -200,13 +223,17 @@ class LlamaAttention(nn.Module):
         Without ``kv_cache`` this is the training path, unchanged.  With
         one (see :mod:`apex_tpu.serving.kv_cache`), two serving modes:
 
-        - **prefill** (``s > 1``, ``position=None``): the attention
-          itself is the exact training computation over the prompt; the
-          per-token K/V are additionally written into ``kv_cache`` at
-          ``(layer_idx, slot, 0..s)``, so prefill logits are
-          bit-identical to the plain forward by construction.  (Offset
-          prefill is rejected: a chunk's causal attention cannot see
-          earlier cached tokens.)
+        - **chunked prefill** (``s > 1``): ``position`` is a scalar
+          offset — the number of tokens already cached in ``slot``
+          (``None`` means 0, a fresh prompt).  Rope is applied at the
+          true positions ``offset..offset+s``, the chunk's K/V are
+          written into ``kv_cache`` at ``(layer_idx, slot, offset..)``,
+          and the chunk's causal block attends the full ``max_len``
+          cache under per-row bounds (``idx <= offset + row``) — so a
+          chunk reads every previously cached token through the same
+          masked, fixed-extent path decode uses, and chunk logits are
+          bit-identical to the shape-stable uncached forward (context
+          padded to ``max_len``) no matter how the prompt is split.
         - **decode** (``s == 1``): ``position`` is a ``[b]`` vector of
           per-slot depths; rope is applied at the true position, the new
           K/V are appended at ``position``, and attention reads the full
@@ -236,20 +263,16 @@ class LlamaAttention(nn.Module):
         v = v.reshape(s, b, nkv, hd)
 
         decode = kv_cache is not None and s == 1
-        if kv_cache is not None and not decode and position is not None:
-            # offset ("chunked") prefill is NOT supported: a prefill
-            # chunk's causal attention sees only itself, so its hidden
-            # states — and the K/V cached from them at layers >= 1 —
-            # would silently miss every earlier cached token.  Refuse
-            # loudly instead of caching wrong keys.
-            raise ValueError(
-                "prefill always starts a slot at position 0 (pass "
-                "position=None); continuing a stream is what decode "
-                "steps are for")
         if decode:
             # rope at each slot's true depth ([b]-vector offset)
             freqs = _rope_freqs(s, hd, cfg.rope_theta,
                                 offset=jnp.asarray(position))
+        elif kv_cache is not None:
+            # chunked prefill: rope at offset..offset+s (scalar offset;
+            # 0 == a fresh prompt's first chunk)
+            offset = jnp.asarray(0 if position is None else position,
+                                 jnp.int32)
+            freqs = _rope_freqs(s, hd, cfg.rope_theta, offset=offset)
         else:
             freqs = _rope_freqs(s, hd, cfg.rope_theta)
         q = fused_apply_rotary_pos_emb(q, freqs)
@@ -275,15 +298,35 @@ class LlamaAttention(nn.Module):
                 vt = vc.transpose(0, 2, 1, 3)
                 ctx = _decode_attention(qt, kt, vt, position)
             else:
-                # prefill: training-exact attention over the prompt; the
-                # cache write is purely additive
+                # chunked prefill: write the chunk's K/V at the offset,
+                # then attend over the whole masked cache — the chunk's
+                # own rows AND every previously cached token go through
+                # one fixed-extent read, so splitting a prompt into
+                # chunks never changes any bit
                 if b != 1:
                     raise ValueError(
                         f"prefill expects one slot per call (b=1), got "
                         f"b={b}")
                 kv_cache = kvc.prefill_into_slot(
-                    kv_cache, layer_idx, slot, k[:, 0], v[:, 0])
-        if not decode:
+                    kv_cache, layer_idx, slot, k[:, 0], v[:, 0],
+                    start=offset)
+                kc = jax.lax.dynamic_index_in_dim(
+                    kv_cache.k[layer_idx], jnp.asarray(slot, jnp.int32),
+                    axis=0, keepdims=False).astype(q.dtype)  # [max,nkv,hd]
+                vc = jax.lax.dynamic_index_in_dim(
+                    kv_cache.v[layer_idx], jnp.asarray(slot, jnp.int32),
+                    axis=0, keepdims=False).astype(q.dtype)
+                if nkv != nq:
+                    rep = nq // nkv
+                    kc = jnp.repeat(kc, rep, axis=1)
+                    vc = jnp.repeat(vc, rep, axis=1)
+                qt = q.transpose(1, 2, 0, 3)        # [1, nq, s, hd]
+                kt = kc.transpose(1, 0, 2)[None]    # [1, nq, max, hd]
+                vt = vc.transpose(1, 0, 2)[None]
+                bounds = (offset
+                          + jnp.arange(s, dtype=jnp.int32))[None]  # [1, s]
+                ctx = _cached_attention(qt, kt, vt, bounds)
+        if kv_cache is None:
             # GQA: each kv head serves nq/nkv query heads
             if nkv != nq:
                 rep = nq // nkv
@@ -362,9 +405,11 @@ class LlamaForCausalLM(nn.Module):
 
         With ``kv_cache`` (a :class:`apex_tpu.serving.kv_cache.KVCache`)
         the call returns ``(logits, kv_cache)`` instead of logits/loss:
-        ``input_ids [1, s>1]`` + ``slot`` prefills one slot, ``input_ids
-        [slots, 1]`` + ``position [slots]`` runs one batched decode step
-        (see :class:`apex_tpu.serving.engine.DecodeEngine`).  ``labels``
+        ``input_ids [1, s>1]`` + ``slot`` (+ scalar ``position`` = the
+        chunk's start offset, 0/None for a fresh prompt) prefills one
+        chunk of one slot, ``input_ids [slots, 1]`` + ``position
+        [slots]`` runs one batched decode step (see
+        :class:`apex_tpu.serving.engine.DecodeEngine`).  ``labels``
         is a training-only argument and rejected in serving mode.  The
         default (``kv_cache=None``) path is unchanged.
         """

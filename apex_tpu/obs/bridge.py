@@ -23,11 +23,11 @@ idempotent.  The default log sink is untouched: ``emit_event`` output
 stays byte-identical with or without the bridge.
 
 Serving **gauges** (queue depth, slot occupancy, cache utilization,
-decode compiles) are declared here but *set directly* by the scheduler
-each step — a gauge describes current state, and routing it through the
-event stream would tie its freshness to ``log_interval``.  Pipeline
-timers publish through :data:`TIMER_SECONDS` via
-``Timers.publish_metrics()``.
+prefill backlog, decode compiles) are declared here but *set directly*
+by the scheduler each step — a gauge describes current state, and
+routing it through the event stream would tie its freshness to
+``log_interval``.  Pipeline timers publish through
+:data:`TIMER_SECONDS` via ``Timers.publish_metrics()``.
 """
 
 from __future__ import annotations
@@ -70,6 +70,10 @@ CHECKPOINTS_REJECTED = metrics.counter(
 SERVING_TTFT = metrics.histogram(
     "apex_serving_ttft_seconds",
     "request submit -> first token (queue wait + prefill)")
+SERVING_PREFILL_DURATION = metrics.histogram(
+    "apex_serving_prefill_duration_seconds",
+    "wall time of one prefill-chunk dispatch, by bucket size",
+    ("bucket",))
 SERVING_PER_TOKEN = metrics.histogram(
     "apex_serving_decode_per_token_seconds",
     "steady-state decode latency per generated token")
@@ -86,6 +90,10 @@ SERVING_CACHE_UTILIZATION = metrics.gauge(
 SERVING_DECODE_COMPILES = metrics.gauge(
     "apex_serving_decode_compiles",
     "distinct compiles of the batched decode step (1 == shape-stable)")
+SERVING_PREFILL_BACKLOG = metrics.gauge(
+    "apex_serving_prefill_backlog",
+    "prompt tokens admitted or queued but not yet cached (deferred by "
+    "the per-step prefill budget)")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -138,6 +146,15 @@ def _on_serving_first_token(event: dict) -> None:
         SERVING_TTFT.observe(ttft_s)
 
 
+def _on_serving_prefill_chunk(event: dict) -> None:
+    duration_s = _measurement(event, "duration_s")
+    bucket = event.get("bucket")
+    # the bucket label comes from the engine's fixed bucket table, so
+    # cardinality is bounded by construction (log2(prefill_len) series)
+    if duration_s is not None and isinstance(bucket, int):
+        SERVING_PREFILL_DURATION.observe(duration_s, bucket=str(bucket))
+
+
 def _on_serving_request_finished(event: dict) -> None:
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
@@ -157,6 +174,7 @@ _HANDLERS = {
     "fault_injected": _on_fault_injected,
     "checkpoint_rejected": _on_checkpoint_rejected,
     "serving_first_token": _on_serving_first_token,
+    "serving_prefill_chunk": _on_serving_prefill_chunk,
     "serving_request_finished": _on_serving_request_finished,
 }
 

@@ -7,18 +7,29 @@ head matmul), its amp policies, and its resilience checkpoints:
 
 - :mod:`.kv_cache` — preallocated slot-indexed decode cache
   (``[layers, slots, max_len, kv_heads, head_dim]``) with per-slot
-  lengths and pure ``lax.dynamic_update_slice`` updates: one static
-  shape for every decode step, zero recompiles after warmup.
-- :mod:`.engine` — :class:`DecodeEngine`: a jitted prefill (full-prompt
-  forward that also fills a slot) + a jitted batched single-token decode
-  step, with deterministic greedy/temperature/top-k sampling from
-  explicit PRNG keys.  Cached incremental decode is bit-identical to
-  the uncached full-context forward (the tier-1 acceptance test).
+  lengths and pure shape-stable updates (drop-mode row scatter for
+  prefill chunks, vmapped ``lax.dynamic_update_slice`` for decode
+  appends): one static shape for every decode step, zero recompiles
+  after warmup.
+- :mod:`.engine` — :class:`DecodeEngine`: length-bucketed **chunked
+  prefill** (a prompt chunk is padded to the smallest covering
+  power-of-two bucket, so a short prompt costs a short dispatch and
+  compile count is bounded by the bucket table; prompts up to
+  ``max_len`` serve — chunks past the first read the cached context
+  through the decode path's masked fixed-extent attention) + a jitted
+  batched single-token decode step, with deterministic
+  greedy/temperature/top-k sampling from explicit PRNG keys.  Prefill
+  AND cached incremental decode are bit-identical to the shape-stable
+  uncached full-context forward (the tier-1 acceptance tests).
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: bounded
-  FIFO queue, slot admission at step boundaries, QUEUED → PREFILL →
-  DECODE → DONE per-request state machine, EOS/max-token eviction with
-  immediate slot reuse, and structured telemetry (queue depth, TTFT,
-  per-token latency, tokens/s) via ``emit_event``.
+  FIFO queue, slot admission at step boundaries, a per-step
+  ``prefill_budget`` (in tokens) that interleaves prompt chunks with
+  the shared decode step — a long admission never stalls live streams
+  for its whole prefill — QUEUED → PREFILL → DECODE → DONE per-request
+  state machine, EOS/max-token eviction with immediate slot reuse, and
+  structured telemetry (queue depth, prefill backlog, per-chunk
+  dispatch time, TTFT, per-token latency, tokens/s) via
+  ``emit_event``.
 - :mod:`.weights` — :func:`load_serving_params`: newest *valid* step
   from a resilience checkpoint root (v1 whole-tree and v2 sharded both
   work), params subtree selection, and bf16 serving casts through
@@ -42,6 +53,7 @@ End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
 
 from apex_tpu.serving.engine import (
     DecodeEngine,
+    default_prefill_buckets,
     request_key,
     sample_tokens,
     token_key,
@@ -71,6 +83,7 @@ __all__ = [
     "release_slot",
     "valid_token_mask",
     "DecodeEngine",
+    "default_prefill_buckets",
     "request_key",
     "sample_tokens",
     "token_key",

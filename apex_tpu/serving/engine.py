@@ -1,28 +1,41 @@
-"""DecodeEngine: jitted prefill + single-token decode over a KV cache.
+"""DecodeEngine: bucketed chunked prefill + single-token decode over a
+KV cache.
 
-Wraps :class:`~apex_tpu.models.llama.LlamaForCausalLM` with exactly two
-compiled programs — a **prefill** (full-prompt forward that also fills
-one cache slot) and a **batched decode step** (one token per slot) —
-both shape-stable by construction: prompts are padded to a fixed
-``prefill_len``, decode always runs all ``slots`` lanes, and the cache
-is preallocated (:mod:`apex_tpu.serving.kv_cache`).  After the warmup
-call each function's jit cache holds exactly one entry no matter how
-requests arrive (`tests/test_serving.py` asserts this via
-``jax.jit``'s ``_cache_size``).
+Wraps :class:`~apex_tpu.models.llama.LlamaForCausalLM` with a *bounded*
+set of compiled programs — one **prefill chunk** program per bucket in
+a small power-of-two bucket table (a short prompt costs a short
+dispatch instead of a full ``prefill_len``-sized one) and exactly one
+**batched decode step** (one token per slot) — all shape-stable by
+construction: a chunk is padded to the smallest covering bucket, decode
+always runs all ``slots`` lanes, and the cache is preallocated
+(:mod:`apex_tpu.serving.kv_cache`).  After warmup the decode jit cache
+holds exactly one entry and the prefill jit cache at most one entry per
+bucket, no matter how requests arrive (`tests/test_serving.py` asserts
+both through :func:`apex_tpu.utils.compat.compile_count`).
 
-Numerics contract (the acceptance bar): greedy incremental decode
-through the cache is **bit-identical** — same f32 logits — to the
-*shape-stable* uncached full-context forward (context padded to
-``max_len``, the recompile-free form a TPU server would actually run)
-at every length, and produces the identical greedy argmax stream as the
-unpadded forward, including GQA configs.  Ingredients: rope applied at
-the true position through ``_rope_freqs``'s vector-offset path,
-attention reads masked with the flash kernels' exact ``-1e30`` (masked
-``exp`` underflows to 0.0, so same-extent reductions round
-identically; see ``models.llama._decode_attention``), and logits
-through the same ``parallel_lm_logits`` head matmul as the plain
-forward (the fused LM *head-loss* kernel is training-only — serving
-has no labels).
+Prompts longer than ``prefill_len`` are served by **chunked cached
+prefill**: the prompt is split into ``prefill_len``-sized chunks (tail
+bucketed), and each chunk's causal block attends previously cached
+tokens through the same masked fixed-extent read the decode step uses —
+any prompt up to ``max_len`` serves, and splitting never changes a bit.
+(That fixed extent is also the cost model: a chunk's attention reads
+the full ``max_len`` axis — ``O(bucket * max_len)`` — while the
+bucket-scaled projections/MLP/head dominate at transformer widths; see
+``docs/api/serving.md`` for the honest accounting.)
+
+Numerics contract (the acceptance bar): prefill *and* greedy
+incremental decode through the cache are **bit-identical** — same f32
+logits — to the *shape-stable* uncached full-context forward (context
+padded to ``max_len``, the recompile-free form a TPU server would
+actually run) at every length and under every chunk split, and produce
+the identical greedy argmax stream as the unpadded forward, including
+GQA configs.  Ingredients: rope applied at the true position through
+``_rope_freqs``'s offset paths, attention reads masked with the flash
+kernels' exact ``-1e30`` (masked ``exp`` underflows to 0.0, so
+same-extent reductions round identically; see
+``models.llama._cached_attention``), and logits through the same
+``parallel_lm_logits`` head matmul as the plain forward (the fused LM
+*head-loss* kernel is training-only — serving has no labels).
 
 Sampling is a pure function of ``(logits, key, temperature, top_k)``
 with explicit PRNG keys — no ambient state, so a replayed request
@@ -32,7 +45,7 @@ reproduces its exact token stream.
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -41,8 +54,10 @@ from jax import lax
 
 from apex_tpu._logging import get_logger
 from apex_tpu.serving.kv_cache import KVCache, init_cache
+from apex_tpu.utils.compat import compile_count
 
-__all__ = ["DecodeEngine", "sample_tokens", "request_key", "token_key"]
+__all__ = ["DecodeEngine", "default_prefill_buckets", "sample_tokens",
+           "request_key", "token_key"]
 
 logger = get_logger("serving.engine")
 
@@ -78,6 +93,31 @@ temperatures [n], top_ks [n]) -> tokens [n]`` — deterministic per
 ``(base_key, index)``; equals sampling with ``token_key(base, index)``."""
 
 
+def default_prefill_buckets(prefill_len: int,
+                            floor: int = 16) -> tuple:
+    """Power-of-two chunk-size table ``(floor, 2*floor, ...,
+    prefill_len)`` — the compile-count budget of the prefill path.
+
+    A prompt (or prompt chunk) is padded to the smallest covering
+    bucket, so a short prompt costs a short dispatch while the number
+    of distinct compiled prefill programs stays ``len(buckets)`` —
+    logarithmic in ``prefill_len``, bounded and asserted rather than
+    hoped (``DecodeEngine.prefill_compiles()``).
+    """
+    if floor < 2:
+        # floor <= 0 would loop forever below (0 * 2 == 0); 1-row
+        # chunks are rejected by the engine anyway (decode ambiguity)
+        raise ValueError(f"bucket floor must be >= 2, got {floor}")
+    if prefill_len <= floor:
+        return (prefill_len,)
+    out, b = [], floor
+    while b < prefill_len:
+        out.append(b)
+        b *= 2
+    out.append(prefill_len)
+    return tuple(out)
+
+
 def request_key(seed: int) -> jax.Array:
     """Base PRNG key for one request (explicit, replayable)."""
     return jax.random.PRNGKey(seed)
@@ -98,14 +138,17 @@ class DecodeEngine:
     >>> eng.release(0)                            # O(1) slot reuse
 
     The engine owns the cache functionally: every call swaps in the
-    updated :class:`KVCache`.  ``slots``/``max_len``/``prefill_len`` are
-    compile-time constants — choose ``prefill_len`` as the prompt-length
-    ceiling (prompts are right-padded to it; the padded K/V are written
-    but never readable, because per-slot lengths mask them).
+    updated :class:`KVCache`.  ``slots``/``max_len``/``prefill_len``/
+    ``prefill_buckets`` are compile-time constants — ``prefill_len`` is
+    the *chunk-size* ceiling (prompts up to ``max_len`` serve; anything
+    longer than ``prefill_len`` is split into chunks), and each chunk
+    is padded to the smallest covering bucket (the padded K/V are
+    written but never readable, because per-slot lengths mask them).
     """
 
     def __init__(self, model, params, *, slots: int = 8,
                  max_len: int = 512, prefill_len: int = 64,
+                 prefill_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=None):
         if prefill_len < 2:
             raise ValueError("prefill_len must be >= 2 (a length-1 "
@@ -116,11 +159,27 @@ class DecodeEngine:
                              f"{max_len}")
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        if prefill_buckets is None:
+            prefill_buckets = default_prefill_buckets(int(prefill_len))
+        buckets = tuple(int(b) for b in prefill_buckets)
+        if not buckets or list(buckets) != sorted(set(buckets)):
+            raise ValueError(f"prefill_buckets must be non-empty, "
+                             f"strictly ascending ints, got {buckets}")
+        if buckets[0] < 2:
+            raise ValueError(f"prefill buckets must be >= 2 (a 1-row "
+                             f"chunk is indistinguishable from a decode "
+                             f"step), got {buckets}")
+        if buckets[-1] != int(prefill_len):
+            raise ValueError(
+                f"the largest prefill bucket must equal prefill_len "
+                f"{prefill_len} (it is the full-chunk program), got "
+                f"{buckets}")
         self.model = model
         self.params = params
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
+        self.prefill_buckets = buckets
         if cache_dtype is None:
             # serve in the params' own precision (bf16 params -> bf16
             # cache); fall back to f32 for exotic all-int trees
@@ -128,21 +187,34 @@ class DecodeEngine:
                       if hasattr(l, "dtype")
                       and jnp.issubdtype(l.dtype, jnp.floating)]
             cache_dtype = floats[0] if floats else jnp.float32
-        self._cache = init_cache(model.config, slots=slots,
-                                 max_len=max_len, dtype=cache_dtype)
+        # commit the fresh cache to its device up front: the first
+        # prefill otherwise sees UNCOMMITTED zeros while every later
+        # call sees the jit output's committed placement — same trace,
+        # but pjit specializes a SECOND executable for the changed
+        # placement, and the "compiles bounded by the bucket table"
+        # contract would be off by one (environment-dependently)
+        self._cache = jax.device_put(
+            init_cache(model.config, slots=slots, max_len=max_len,
+                       dtype=cache_dtype),
+            jax.local_devices()[0])
         # host mirror of per-slot lengths: lets every call validate slot
         # bounds and cache capacity WITHOUT a device->host sync on the
         # decode hot path (dynamic_update_slice clamps out-of-range
         # indices silently — overflow must be an error, not corruption)
         self._lengths_host = np.zeros((self.slots,), np.int64)
 
-        def _prefill(params, cache, ids, slot, length):
-            # ids [1, prefill_len]; returns the logits at the LAST REAL
-            # position (the next-token distribution) + the filled cache
+        def _prefill(params, cache, ids, slot, offset, length):
+            # ids [1, B] (one bucket's shape — jit compiles one program
+            # per bucket, never per prompt length); offset = tokens
+            # already cached in the slot; length = REAL tokens in this
+            # chunk.  Returns the logits at the chunk's last real
+            # position (the next-token distribution after the final
+            # chunk) + the filled cache.
             logits, cache = model.apply(params, ids, kv_cache=cache,
-                                        slot=slot)
+                                        slot=slot, position=offset)
             cache = dataclasses.replace(
-                cache, lengths=cache.lengths.at[slot].set(length))
+                cache,
+                lengths=cache.lengths.at[slot].set(offset + length))
             last = lax.dynamic_index_in_dim(logits[:, 0, :], length - 1,
                                             axis=0, keepdims=False)
             return last.astype(jnp.float32), cache
@@ -165,8 +237,9 @@ class DecodeEngine:
         self._prefill = jax.jit(_prefill, donate_argnums=(1,))
         self._decode = jax.jit(_decode, donate_argnums=(1,))
         logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
-                     "cache_dtype=%s", self.slots, self.max_len,
-                     self.prefill_len, jnp.dtype(cache_dtype).name)
+                     "buckets=%s cache_dtype=%s", self.slots,
+                     self.max_len, self.prefill_len,
+                     self.prefill_buckets, jnp.dtype(cache_dtype).name)
 
     # ---- cache/slot state ------------------------------------------------
     @property
@@ -211,12 +284,58 @@ class DecodeEngine:
     def decode_compiles(self) -> int:
         """Number of distinct compiles of the decode step (1 == the
         shape-stable contract held: no per-request retraces)."""
-        return self._decode._cache_size()
+        return compile_count(self._decode)
 
-    # ---- the two compiled programs ---------------------------------------
+    def prefill_compiles(self) -> int:
+        """Number of distinct compiles of the prefill-chunk program —
+        bounded by ``len(prefill_buckets)`` (each bucket is one input
+        shape), asserted in tier-1 and by the bench regression guard."""
+        return compile_count(self._prefill)
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest prefill bucket covering an ``n``-token chunk."""
+        if not 1 <= n <= self.prefill_len:
+            raise ValueError(f"chunk length {n} not in [1, "
+                             f"{self.prefill_len}]")
+        return next(b for b in self.prefill_buckets if b >= n)
+
+    # ---- the compiled programs -------------------------------------------
+    def prefill_chunk(self, slot: int, tokens: Sequence[int]) -> jax.Array:
+        """Cache one prompt chunk (``<= prefill_len`` tokens) at
+        ``slot``'s current depth; returns the next-token logits
+        ``[vocab]`` (f32) after the chunk's last real token — the
+        first-token distribution when this was the prompt's final chunk,
+        an intermediate prediction otherwise.
+
+        The chunk is padded to the smallest covering bucket (one compile
+        per bucket, ever) and its causal block attends everything the
+        slot already cached, so ``prefill_chunk`` *continues* a slot:
+        callers own the slot's lifecycle and must feed chunks of one
+        prompt in order (the scheduler does; for one-shot use call
+        :meth:`prefill`, which also guards against clobbering a live
+        stream).
+        """
+        self._check_slot(slot)
+        n = len(tokens)
+        bucket = self.bucket_for(n)      # raises on n < 1 / n too long
+        offset = int(self._lengths_host[slot])
+        if offset + n > self.max_len:
+            raise ValueError(
+                f"chunk of {n} tokens at offset {offset} overruns cache "
+                f"max_len {self.max_len}")
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :n] = np.asarray(tokens, np.int32)
+        logits, self._cache = self._prefill(
+            self.params, self._cache, jnp.asarray(ids),
+            jnp.int32(slot), jnp.int32(offset), jnp.int32(n))
+        self._lengths_host[slot] = offset + n
+        return logits
+
     def prefill(self, slot: int, tokens: Sequence[int]) -> jax.Array:
-        """Fill ``slot`` with a prompt; return its next-token logits
-        ``[vocab]`` (f32)."""
+        """Fill ``slot`` with a whole prompt (chunked as needed); return
+        its next-token logits ``[vocab]`` (f32).  Prompts up to
+        ``max_len`` serve — anything longer than ``prefill_len`` runs as
+        ``prefill_len``-sized chunks plus a bucketed tail."""
         self._check_slot(slot)
         if self._lengths_host[slot]:
             raise ValueError(
@@ -225,15 +344,13 @@ class DecodeEngine:
                 f"clobbering a live stream is the corruption class these "
                 f"guards exist for")
         n = len(tokens)
-        if not 1 <= n <= self.prefill_len:
+        if not 1 <= n <= self.max_len:
             raise ValueError(f"prompt length {n} not in [1, "
-                             f"{self.prefill_len}]")
-        ids = np.zeros((1, self.prefill_len), np.int32)
-        ids[0, :n] = np.asarray(tokens, np.int32)
-        logits, self._cache = self._prefill(
-            self.params, self._cache, jnp.asarray(ids),
-            jnp.int32(slot), jnp.int32(n))
-        self._lengths_host[slot] = n
+                             f"{self.max_len}] (cache capacity)")
+        logits = None
+        for start in range(0, n, self.prefill_len):
+            logits = self.prefill_chunk(
+                slot, tokens[start:start + self.prefill_len])
         return logits
 
     def decode(self, tokens, active) -> jax.Array:

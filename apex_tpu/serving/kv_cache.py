@@ -3,8 +3,10 @@
 The serving-side win on TPUs (PAPERS.md: "Fine-Tuning and Serving Gemma
 on Google Cloud TPU") comes from never letting XLA see a new shape after
 warmup: the cache is **preallocated** at ``[layers, slots, max_len,
-kv_heads, head_dim]``, every prefill/append is a
-``lax.dynamic_update_slice`` into that fixed buffer, and attention reads
+kv_heads, head_dim]``, every update is a shape-stable write into that
+fixed buffer (a drop-mode row scatter for prefill chunks — overhanging
+bucket padding must be dropped, never clamped backward — and a vmapped
+``lax.dynamic_update_slice`` for decode appends), and attention reads
 the *whole* ``max_len`` axis with a per-slot length mask — so one
 compiled decode step serves every request mix, every sequence length,
 and every slot assignment with zero retraces.
@@ -88,21 +90,32 @@ def init_cache(config: Any, *, slots: int, max_len: int,
 
 def prefill_into_slot(cache: KVCache, layer: int, slot, k_seq, v_seq,
                       start=0) -> KVCache:
-    """Write a whole (padded) prompt's K/V into one slot of one layer.
+    """Write one (padded) prompt chunk's K/V into one slot of one layer,
+    at offset ``start`` (0 == a fresh prompt; later chunks of a long
+    prompt pass the tokens-already-cached count).
 
-    ``k_seq`` / ``v_seq``: ``[prompt_len, kv_heads, head_dim]``; ``slot``
+    ``k_seq`` / ``v_seq``: ``[chunk_len, kv_heads, head_dim]``; ``slot``
     and ``start`` may be traced scalars, ``layer`` is a Python int.  Does
     NOT touch ``lengths`` — the caller sets the slot's *real* length once
-    per model call (prompt padding past it stays masked garbage).
+    per model call (chunk padding past it stays masked garbage until the
+    next chunk overwrites it).
+
+    The write is a per-row scatter with ``mode="drop"``, NOT a
+    ``dynamic_update_slice``: a bucket-padded tail chunk near the cache
+    end (``start + chunk_len > max_len`` even though every *real* token
+    fits) must have its overhanging padding rows DROPPED — a
+    dynamic-update would silently clamp the whole block backward and
+    overwrite previously cached real K/V.
     """
-    upd_k = k_seq.astype(cache.dtype)[None, None]  # [1,1,P,kvh,hd]
-    upd_v = v_seq.astype(cache.dtype)[None, None]
-    idx = (jnp.int32(layer), jnp.asarray(slot, jnp.int32),
-           jnp.asarray(start, jnp.int32), jnp.int32(0), jnp.int32(0))
+    rows = jnp.asarray(start, jnp.int32) + jnp.arange(
+        k_seq.shape[0], dtype=jnp.int32)
+    s = jnp.asarray(slot, jnp.int32)
     return dataclasses.replace(
         cache,
-        k=lax.dynamic_update_slice(cache.k, upd_k, idx),
-        v=lax.dynamic_update_slice(cache.v, upd_v, idx))
+        k=cache.k.at[layer, s, rows].set(k_seq.astype(cache.dtype),
+                                         mode="drop"),
+        v=cache.v.at[layer, s, rows].set(v_seq.astype(cache.dtype),
+                                         mode="drop"))
 
 
 def append_token(cache: KVCache, layer: int, k_tok, v_tok,
@@ -143,11 +156,13 @@ def valid_token_mask(positions, max_len: int):
     """``[slots, max_len]`` bool: True where ``idx <= position``.
 
     ``positions`` is the index of each slot's *current* token (visible to
-    itself), i.e. the pre-append ``cache.lengths``.  This is THE cache
-    read mask — ``models.llama._decode_attention`` applies it to the
-    attention scores, so masking semantics live here exactly once.
-    (``.astype(jnp.int32)`` turns it into segment ids for
-    ``flash_attention(segment_ids=...)`` if a kernel path ever wants it.)
+    itself), i.e. the pre-append ``cache.lengths``.  This is the decode
+    read mask — ``models.llama._cached_attention`` applies the same
+    ``idx <= bound`` semantics per query row (decode passes one bound
+    per slot; a prefill chunk passes ``offset + row``), so masking
+    semantics live in one predicate.  (``.astype(jnp.int32)`` turns it
+    into segment ids for ``flash_attention(segment_ids=...)`` if a
+    kernel path ever wants it.)
     """
     idx = jnp.arange(max_len, dtype=jnp.int32)[None, :]
     return idx <= jnp.asarray(positions, jnp.int32)[:, None]

@@ -12,20 +12,32 @@ boundaries:
   unbounded buffer).
 - **Slot admission**: at each step boundary, free slots are filled from
   the queue in FIFO order (no starvation: a request's wait is bounded by
-  the streams ahead of it) — one prefill per admitted request, then the
-  shared decode step serves every active slot.
+  the streams ahead of it).
+- **Prefill/decode interleaving**: prompt caching is *chunked* and
+  metered by a per-step ``prefill_budget`` (in tokens) — each step
+  spends at most the budget on prefill chunks (oldest admitted request
+  first), then runs the shared batched decode step for every decoding
+  slot.  A long prompt therefore never stalls live streams for its
+  whole length: it advances one chunk at a time while decode keeps
+  producing tokens, and the deferred remainder is visible as the
+  ``apex_serving_prefill_backlog`` gauge.  Prompts longer than the
+  engine's ``prefill_len`` (up to cache capacity) are admitted — the
+  chunked cached prefill path serves them.
 - **Per-request state machine**: QUEUED → PREFILL → DECODE → DONE, with
   eviction on EOS or ``max_new_tokens`` and *immediate* slot reuse at
   the same step boundary.
 - **Telemetry**: structured ``emit_event`` lines
   (:mod:`apex_tpu._logging`) — ``serving_request_admitted`` /
-  ``serving_first_token`` (time-to-first-token) /
+  ``serving_prefill_chunk`` (per-chunk bucket + dispatch wall time,
+  feeding the ``apex_serving_prefill_duration_seconds{bucket}``
+  histogram) / ``serving_first_token`` (time-to-first-token) /
   ``serving_request_finished`` (tokens/s, mean per-token latency) per
   request, and a ``serving_step`` sample (queue depth, active slots,
-  slot occupancy, KV-cache utilization) every ``log_interval`` steps.
-  Current-state gauges (:mod:`apex_tpu.obs.bridge`:
-  ``apex_serving_queue_depth`` / ``apex_serving_slot_occupancy`` /
-  ``apex_serving_cache_utilization``) refresh every step, so a
+  slot occupancy, KV-cache utilization, prefill backlog) every
+  ``log_interval`` steps.  Current-state gauges
+  (:mod:`apex_tpu.obs.bridge`: ``apex_serving_queue_depth`` /
+  ``apex_serving_slot_occupancy`` / ``apex_serving_cache_utilization``
+  / ``apex_serving_prefill_backlog``) refresh every step, so a
   Prometheus scrape sees live state regardless of ``log_interval``.
 
 Determinism: sampling draws from explicit per-request PRNG keys
@@ -98,11 +110,17 @@ class RequestResult:
 class _Active:
     request: Request
     slot: int
+    seq: int                 # admission order (FIFO prefill priority)
     base_key: np.ndarray     # host copy; folded per token INSIDE the sampler
     tokens: List[int]
     t_submit: float
     t_first: float
-    phase: RequestPhase = RequestPhase.DECODE
+    prompt_pos: int = 0      # prompt tokens cached so far
+    phase: RequestPhase = RequestPhase.PREFILL
+
+    @property
+    def prompt_remaining(self) -> int:
+        return len(self.request.prompt) - self.prompt_pos
 
 
 class ContinuousBatchingScheduler:
@@ -111,19 +129,34 @@ class ContinuousBatchingScheduler:
     >>> sched = ContinuousBatchingScheduler(engine, max_queue=64)
     >>> sched.submit(Request("r0", prompt, max_new_tokens=32, eos_id=2))
     >>> results = sched.run()          # drain queue + all active slots
+
+    ``prefill_budget`` is the prompt-token cap per :meth:`step` (default
+    ``engine.prefill_len`` — one full-size chunk): the knob that trades
+    time-to-first-token for new admissions against decode latency for
+    live streams.  Set it large to drain prompts greedily (admission
+    stalls decode, the pre-budget behavior), small to bound the decode
+    hiccup any single step can suffer.
     """
 
     def __init__(self, engine: DecodeEngine, *, max_queue: int = 64,
                  log_interval: int = 32,
+                 prefill_budget: Optional[int] = None,
                  clock: Callable[[], float] = time.monotonic):
+        if prefill_budget is None:
+            prefill_budget = engine.prefill_len
+        if prefill_budget < 1:
+            raise ValueError(f"prefill_budget must be >= 1 token per "
+                             f"step, got {prefill_budget}")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.log_interval = max(1, int(log_interval))
+        self.prefill_budget = int(prefill_budget)
         self._clock = clock
         self._queue: deque[tuple[Request, float]] = deque()
         self._active: Dict[int, _Active] = {}
         self._results: Dict[str, RequestResult] = {}
         self._step_index = 0
+        self._admit_seq = 0
 
     # ---- submission ------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -144,11 +177,11 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"{request.rid}: max_new_tokens must be >= 1 "
                 f"(got {request.max_new_tokens})")
-        if not 1 <= n <= self.engine.prefill_len:
-            raise ValueError(
-                f"{request.rid}: prompt length {n} not in [1, "
-                f"{self.engine.prefill_len}] (engine prefill buffer)")
-        # the FINAL sampled token is never appended (the request finishes
+        if n < 1:
+            raise ValueError(f"{request.rid}: empty prompt")
+        # prompts longer than prefill_len are fine (chunked cached
+        # prefill serves them); the only hard ceiling is cache capacity.
+        # The FINAL sampled token is never appended (the request finishes
         # right after sampling it), so peak cache use is one less than
         # prompt + output budget — a stream may fill the cache exactly
         if n + request.max_new_tokens - 1 > self.engine.max_len:
@@ -185,12 +218,12 @@ class ContinuousBatchingScheduler:
         return RequestPhase.QUEUED
 
     # ---- the loop --------------------------------------------------------
-    def _admit(self) -> List[str]:
-        """Fill free slots from the queue (FIFO), one prefill each; the
-        first token is sampled from the prefill logits so TTFT includes
-        exactly one prefill + zero decode steps.  Returns rids that
-        finished already at admission (one-token requests, instant EOS)."""
-        finished: List[str] = []
+    def _admit(self) -> None:
+        """Fill free slots from the queue (FIFO).  Admission assigns a
+        slot only — the prompt is cached chunk-by-chunk by
+        :meth:`_prefill_work` under the per-step budget, so admitting a
+        long prompt never blocks this step's decode for its whole
+        length."""
         while self._queue:
             # the engine's slot-occupancy mirror is the ONE source of
             # truth for free slots (a scheduler-side copy could desync
@@ -201,27 +234,58 @@ class ContinuousBatchingScheduler:
                 break
             request, t_submit = self._queue.popleft()
             slot = free[0]
-            st = _Active(request=request, slot=slot,
+            st = _Active(request=request, slot=slot, seq=self._admit_seq,
                          base_key=np.asarray(request_key(request.seed)),
-                         tokens=[], t_submit=t_submit, t_first=0.0,
-                         phase=RequestPhase.PREFILL)
-            logits = self.engine.prefill(slot, request.prompt)
-            tok = int(self.engine.sample(
-                logits[None], st.base_key[None], np.int32([0]),
-                np.float32([request.temperature]),
-                np.int32([request.top_k]))[0])
-            st.t_first = self._clock()
-            st.tokens.append(tok)
-            st.phase = RequestPhase.DECODE
+                         tokens=[], t_submit=t_submit, t_first=0.0)
+            self._admit_seq += 1
             self._active[slot] = st
             logger.debug("admitted %s into slot %d (queue %d deep)",
                          request.rid, slot, len(self._queue))
             emit_event("serving_request_admitted", rid=request.rid,
-                       slot=slot, queue_depth=len(self._queue))
-            emit_event("serving_first_token", rid=request.rid,
-                       ttft_s=round(st.t_first - t_submit, 6))
-            if self._finish_if_done(st):
-                finished.append(request.rid)
+                       slot=slot, prompt_tokens=len(request.prompt),
+                       queue_depth=len(self._queue))
+
+    def _prefill_work(self) -> List[str]:
+        """Spend up to ``prefill_budget`` prompt tokens on chunks,
+        oldest admitted request first (FIFO: a request's first token
+        never waits on a later arrival).  When a prompt completes, its
+        first token is sampled from the final chunk's logits — TTFT
+        includes its prefill chunks + zero decode steps.  Returns rids
+        that finished already at prefill completion (one-token
+        requests, instant EOS)."""
+        finished: List[str] = []
+        budget = self.prefill_budget
+        for st in sorted((s for s in self._active.values()
+                          if s.phase is RequestPhase.PREFILL),
+                         key=lambda s: s.seq):
+            while budget > 0 and st.prompt_remaining:
+                chunk = min(st.prompt_remaining,
+                            self.engine.prefill_len, budget)
+                offset = st.prompt_pos      # the chunk's START position
+                t0 = self._clock()
+                logits = self.engine.prefill_chunk(
+                    st.slot, st.request.prompt[offset:offset + chunk])
+                dt = self._clock() - t0
+                st.prompt_pos = offset + chunk
+                budget -= chunk
+                emit_event("serving_prefill_chunk", rid=st.request.rid,
+                           bucket=self.engine.bucket_for(chunk),
+                           chunk_tokens=chunk, offset_tokens=offset,
+                           duration_s=round(dt, 6))
+                if not st.prompt_remaining:
+                    tok = int(self.engine.sample(
+                        logits[None], st.base_key[None], np.int32([0]),
+                        np.float32([st.request.temperature]),
+                        np.int32([st.request.top_k]))[0])
+                    st.t_first = self._clock()
+                    st.tokens.append(tok)
+                    st.phase = RequestPhase.DECODE
+                    emit_event("serving_first_token", rid=st.request.rid,
+                               ttft_s=round(st.t_first - st.t_submit, 6))
+                    if self._finish_if_done(st):
+                        finished.append(st.request.rid)
+            if budget <= 0:
+                break
         return finished
 
     def _finish_if_done(self, st: _Active) -> bool:
@@ -254,12 +318,24 @@ class ContinuousBatchingScheduler:
                    per_token_ms=round(decode_s / decode_steps * 1e3, 3))
         return True
 
+    @property
+    def prefill_backlog(self) -> int:
+        """Deferred prefill work, in prompt tokens: what the budget has
+        not yet cached for admitted requests, plus every queued
+        request's whole prompt."""
+        return (sum(st.prompt_remaining for st in self._active.values()
+                    if st.phase is RequestPhase.PREFILL)
+                + sum(len(r.prompt) for r, _ in self._queue))
+
     def step(self) -> List[str]:
-        """One step boundary: admit into free slots, then one shared
-        decode step for every active slot.  Returns rids finished at
-        this boundary."""
-        finished = self._admit()
-        if self._active:
+        """One step boundary: admit into free slots, spend the prefill
+        budget on prompt chunks, then one shared decode step for every
+        decoding slot.  Returns rids finished at this boundary."""
+        self._admit()
+        finished = self._prefill_work()
+        decoding = {slot: st for slot, st in self._active.items()
+                    if st.phase is RequestPhase.DECODE}
+        if decoding:
             slots = self.engine.slots
             tokens = np.zeros((slots,), np.int32)
             active = np.zeros((slots,), bool)
@@ -267,7 +343,7 @@ class ContinuousBatchingScheduler:
             indices = np.zeros((slots,), np.int32)
             temps = np.zeros((slots,), np.float32)
             top_ks = np.zeros((slots,), np.int32)
-            for slot, st in self._active.items():
+            for slot, st in decoding.items():
                 tokens[slot] = st.tokens[-1]
                 active[slot] = True
                 base_keys[slot] = st.base_key
@@ -275,11 +351,14 @@ class ContinuousBatchingScheduler:
                 temps[slot] = st.request.temperature
                 top_ks[slot] = st.request.top_k
             # per-step device work: ONE decode dispatch + ONE sampler
-            # dispatch (keys fold inside the sampler) + one readback
+            # dispatch (keys fold inside the sampler) + one readback;
+            # mid-prefill slots ride as inactive lanes (their lengths
+            # never advance, and the next chunk overwrites the lane's
+            # masked garbage write)
             logits = self.engine.decode(tokens, active)
             sampled = np.asarray(self.engine.sample(
                 logits, base_keys, indices, temps, top_ks))
-            for slot, st in list(self._active.items()):
+            for slot, st in list(decoding.items()):
                 st.tokens.append(int(sampled[slot]))
                 if self._finish_if_done(st):
                     finished.append(st.request.rid)
@@ -290,9 +369,11 @@ class ContinuousBatchingScheduler:
         # be inferred from the other
         occupancy = len(self._active) / max(self.engine.slots, 1)
         cache_util = self.engine.cache_utilization()
+        backlog = self.prefill_backlog
         obs_bridge.SERVING_QUEUE_DEPTH.set(len(self._queue))
         obs_bridge.SERVING_SLOT_OCCUPANCY.set(occupancy)
         obs_bridge.SERVING_CACHE_UTILIZATION.set(cache_util)
+        obs_bridge.SERVING_PREFILL_BACKLOG.set(backlog)
         # every step like the others (a cheap host-side jit-cache read):
         # a scrape during the first log_interval steps must not read 0
         # for a gauge documented as "1 == shape-stable"
@@ -302,7 +383,8 @@ class ContinuousBatchingScheduler:
                        queue_depth=len(self._queue),
                        active_slots=len(self._active),
                        slot_occupancy=round(occupancy, 4),
-                       cache_utilization=round(cache_util, 6))
+                       cache_utilization=round(cache_util, 6),
+                       prefill_backlog=backlog)
         return finished
 
     def run(self, max_steps: Optional[int] = None

@@ -26,6 +26,30 @@ try:  # jax >= 0.6 exports it at top level
 except ImportError:  # jax 0.4.x keeps it under experimental
     from jax.experimental.shard_map import shard_map
 
+
+def compile_count(fn) -> int:
+    """Number of compiled variants a ``jax.jit``-wrapped function holds.
+
+    The serving contract ("the decode step compiles exactly ONCE",
+    "prefill compiles are bounded by the bucket table") is asserted in
+    tier-1 through jit cache statistics, but the probe is private API
+    that has already been renamed once across jax versions
+    (``_cache_size()`` today, ``cache_size()`` upstream).  This helper
+    is the ONE place that knows the spelling — every compile-count
+    assertion (``DecodeEngine.decode_compiles()`` /
+    ``prefill_compiles()``, bench regression guards, tests) goes
+    through it, so the next rename is a one-line fix here instead of a
+    scavenger hunt.
+    """
+    for probe in ("_cache_size", "cache_size"):
+        attr = getattr(fn, probe, None)
+        if callable(attr):
+            return int(attr())
+    raise AttributeError(
+        f"{fn!r} exposes no jit cache-size probe (tried _cache_size/"
+        f"cache_size) — is it a jax.jit-wrapped function on a supported "
+        f"jax version?")
+
 # Disabling the replication checker is the repo-wide default for
 # shard_map: the collective helpers mix per-leaf specs and produce
 # outputs made replicated by explicit psum/all_gather, which older
@@ -35,4 +59,4 @@ NO_REP_CHECK = (
     if "check_vma" in inspect.signature(shard_map).parameters
     else {"check_rep": False})
 
-__all__ = ["NO_REP_CHECK", "shard_map"]
+__all__ = ["NO_REP_CHECK", "compile_count", "shard_map"]

@@ -248,12 +248,16 @@ def test_elastic_metrics_block():
 
 
 def test_serving_metrics_block():
-    """The serving block (ISSUE 4 satellite): prefill tokens/s, per-token
-    decode latency, and continuous-batching throughput at 1/4/8 streams
-    with staggered arrivals — plus the shape-stability invariant (ONE
-    decode compile after warmup)."""
+    """The serving block (ISSUE 4 + ISSUE 7 satellites): prefill
+    tokens/s, per-token decode latency, continuous-batching throughput
+    at 1/4/8 streams with staggered arrivals, and the mixed-length
+    bucketed-vs-padded comparison — plus BOTH compile-count regression
+    guards (ONE decode compile after warmup; prefill compiles bounded
+    by the bucket table)."""
     r = bench._serving_metrics(decode_tokens=12, prompt_len=4,
-                               prefill_len=8, max_len=64, slots=8)
+                               prefill_len=32, max_len=64, slots=4,
+                               mixed_streams=4, mixed_decode_tokens=2,
+                               mixed_attempts=1)
     assert r["ok"] is True
     assert r["prefill_tokens_per_s"] > 0.0
     assert r["decode_ms_per_token"] > 0.0
@@ -265,7 +269,20 @@ def test_serving_metrics_block():
     # matter how streams arrive — retraces would be the recompile tax
     # the slotted cache exists to eliminate
     assert r["decode_compiles_after_warmup"] == 1
-    assert r["config"]["slots"] == 8
+    # the prefill path's compile count is bounded by the bucket table —
+    # a per-prompt-length retrace would blow straight through this
+    assert r["prefill_buckets"] == [16, 32]
+    assert 1 <= r["prefill_compiles"] <= len(r["prefill_buckets"])
+    # the mixed-length comparison runs and reports a sane ratio (the
+    # >= 1.5x acceptance bar is measured at the default, bigger sizes —
+    # at this toy size the per-dispatch host tax flattens the ratio)
+    mixed = r["mixed"]
+    assert len(mixed["prompt_lens"]) == 4
+    assert all(1 <= n <= 32 for n in mixed["prompt_lens"])
+    assert mixed["tokens_per_s_bucketed"] > 0.0
+    assert mixed["tokens_per_s_padded"] > 0.0
+    assert mixed["speedup_bucketed_vs_padded"] > 0.0
+    assert r["config"]["slots"] == 4
 
 
 def test_obs_metrics_block():

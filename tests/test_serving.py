@@ -114,17 +114,45 @@ def test_greedy_decode_bit_identical_to_uncached(model, params, full_fwd):
     assert eng.decode_compiles() == 1
 
 
-def test_prefill_is_training_forward_plus_cache_fill(model, params,
-                                                     full_fwd):
-    """Prefill logits equal the PLAIN (jitted) forward on the same padded
-    ids — the cache write is purely additive to the training computation."""
+def test_prefill_is_shape_stable_forward_plus_cache_fill(model, params,
+                                                         full_fwd):
+    """Prefill logits equal the shape-stable uncached forward (context
+    padded to ``max_len``) — the chunk's cached read shares the decode
+    path's reduction extents, so the bucket a prompt lands in never
+    moves a bit."""
     eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
                           prefill_len=8)
     toks = _prompt(n=6)
     got = eng.prefill(0, toks)
-    want = _padded_ref(full_fwd, params, toks, pad_to=8)
+    want = _padded_ref(full_fwd, params, toks)
     assert bool(jnp.all(got == want))
     assert eng.lengths()[0] == 6 and eng.lengths()[1] == 0
+    # one bucket table entry (prefill_len=8 -> (8,)), one compile
+    assert eng.prefill_buckets == (8,)
+    assert eng.prefill_compiles() == 1
+
+
+def test_bucket_table_defaults_and_validation(model, params):
+    assert sv.default_prefill_buckets(8) == (8,)
+    assert sv.default_prefill_buckets(16) == (16,)
+    assert sv.default_prefill_buckets(96) == (16, 32, 64, 96)
+    assert sv.default_prefill_buckets(128) == (16, 32, 64, 128)
+    eng = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                          prefill_len=MAX)
+    assert eng.prefill_buckets == (16, 32, 64, 96)
+    assert eng.bucket_for(1) == 16 and eng.bucket_for(16) == 16
+    assert eng.bucket_for(17) == 32 and eng.bucket_for(96) == 96
+    with pytest.raises(ValueError):           # beyond the chunk ceiling
+        eng.bucket_for(97)
+    with pytest.raises(ValueError):           # not ascending
+        sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                        prefill_len=8, prefill_buckets=(8, 4))
+    with pytest.raises(ValueError):           # last != prefill_len
+        sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                        prefill_len=8, prefill_buckets=(4,))
+    with pytest.raises(ValueError):           # 1-row chunk ambiguous
+        sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                        prefill_len=8, prefill_buckets=(1, 8))
 
 
 # ---------------------------------------------------------------------------
@@ -271,13 +299,15 @@ def test_scheduler_drains_staggered_mixed_workload(model, params):
     eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
                           prefill_len=8)
     admitted = []
-    orig_prefill = eng.prefill
+    orig_chunk = eng.prefill_chunk
 
-    def spy_prefill(slot, tokens):
+    def spy_chunk(slot, tokens):
+        # every prompt here fits one chunk, so first-chunk order IS
+        # admission order
         admitted.append(tuple(tokens))
-        return orig_prefill(slot, tokens)
+        return orig_chunk(slot, tokens)
 
-    eng.prefill = spy_prefill
+    eng.prefill_chunk = spy_chunk
     sched = sv.ContinuousBatchingScheduler(eng, max_queue=8,
                                            log_interval=10 ** 9)
     reqs = [sv.Request(f"r{i}", _prompt(seed=i, n=2 + i % 5),
@@ -334,16 +364,18 @@ def test_queue_and_validation_limits(model, params):
     sched.submit(sv.Request("b", [1], max_new_tokens=1))
     with pytest.raises(sv.QueueFull):
         sched.submit(sv.Request("c", [1], max_new_tokens=1))
-    with pytest.raises(ValueError):           # prompt beyond prefill_len
-        sched.submit(sv.Request("d", [1] * 9, max_new_tokens=1))
+    with pytest.raises(ValueError):           # prompt beyond cache capacity
+        sched.submit(sv.Request("d", [1] * 33, max_new_tokens=1))
     with pytest.raises(ValueError):           # would overrun the cache
         sched.submit(sv.Request("e", [1] * 4, max_new_tokens=40))
-    with pytest.raises(ValueError):           # engine-level prompt check
-        eng.prefill(0, [1] * 9)
+    with pytest.raises(ValueError):           # engine-level capacity check
+        eng.prefill(0, [1] * 33)
     with pytest.raises(ValueError):
         sv.DecodeEngine(model, params, slots=1, max_len=8, prefill_len=16)
     with pytest.raises(ValueError):           # zero-token requests
         sched.submit(sv.Request("f", [1], max_new_tokens=0))
+    with pytest.raises(ValueError):           # zero-token prefill budget
+        sv.ContinuousBatchingScheduler(eng, prefill_budget=0)
     with pytest.raises(ValueError):           # duplicate rid (queued)
         sched.submit(sv.Request("a", [2], max_new_tokens=1))
     with pytest.raises(ValueError):           # slot out of range
@@ -368,10 +400,195 @@ def test_queue_and_validation_limits(model, params):
         ids = jnp.zeros((1, 4), jnp.int32)
         model.apply(params, ids, labels=ids,
                     kv_cache=eng.cache, slot=jnp.int32(0))
-    with pytest.raises(ValueError):           # offset prefill unsupported
-        model.apply(params, jnp.zeros((1, 4), jnp.int32),
-                    kv_cache=eng.cache, slot=jnp.int32(0),
-                    position=jnp.int32(4))
+    with pytest.raises(ValueError):           # chunk past cache capacity
+        eng3b = sv.DecodeEngine(model, params, slots=1, max_len=8,
+                                prefill_len=8)
+        eng3b.prefill_chunk(0, [1] * 6)
+        eng3b.prefill_chunk(0, [1] * 6)       # offset 6 + 6 > 8
+
+
+# ---------------------------------------------------------------------------
+# chunked cached prefill: prompts past prefill_len, bucketed compiles,
+# prefill/decode interleaving (ISSUE 7)
+# ---------------------------------------------------------------------------
+
+
+def test_long_prompt_chunked_prefill_bit_identical(model, params, full_fwd):
+    """THE ISSUE-7 acceptance run: a prompt LONGER than ``prefill_len``
+    (70 > 16) is served via chunked cached prefill — every chunk's
+    causal block reads the previously cached tokens through the masked
+    fixed-extent path — and both the first-token logits and the whole
+    greedy decode stream are bit-identical to the shape-stable uncached
+    forward.  Compile count stays bounded by the bucket table."""
+    eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                          prefill_len=16)
+    toks = _prompt(n=70)                  # chunks 16/16/16/16 + tail 6
+    logits = eng.prefill(0, toks)
+    assert bool(jnp.all(logits == _padded_ref(full_fwd, params, toks)))
+    for _ in range(20):
+        nxt = int(jnp.argmax(logits))
+        toks.append(nxt)
+        logits = eng.decode(np.array([nxt, 0], np.int32),
+                            np.array([True, False]))[0]
+        ref = _padded_ref(full_fwd, params, toks)
+        assert bool(jnp.all(logits == ref)), (
+            f"decode diverged from uncached forward at length {len(toks)}"
+            f" after a chunked prefill")
+    # prefill_len=16 -> bucket table (16,): full chunks AND the 6-token
+    # tail share the single bucket program
+    assert eng.prefill_buckets == (16,)
+    assert eng.prefill_compiles() == 1
+    assert eng.decode_compiles() == 1
+
+
+def test_bucket_padding_overhang_never_clobbers_cached_tokens(
+        model, params, full_fwd):
+    """A bucket-padded tail chunk near the cache end (start + bucket >
+    max_len even though every REAL token fits) must DROP its overhanging
+    padding rows: a clamped block write would silently shift backward
+    onto previously cached real K/V.  max_len=90 is deliberately not
+    bucket-aligned — the 26-token tail of a 90-token prompt pads to a
+    32-row bucket at offset 64, overhanging by 6."""
+    small = 90
+    eng = sv.DecodeEngine(model, params, slots=1, max_len=small,
+                          prefill_len=64)
+    toks = _prompt(n=small)               # chunks: 64 + tail 26 (bucket 32)
+    logits = eng.prefill(0, toks)
+    ref = _padded_ref(full_fwd, params, toks, pad_to=small)
+    assert bool(jnp.all(logits == ref)), (
+        "prefill near the cache end diverged — the padded tail write "
+        "clobbered cached K/V")
+    # scheduler route: budget fragmentation lands a tiny tail at an
+    # unaligned offset (88 + bucket 8 > 90); the stream must still
+    # produce the uncached forward's greedy tokens
+    eng2 = sv.DecodeEngine(model, params, slots=1, max_len=small,
+                           prefill_len=64, prefill_buckets=(8, 16, 64))
+    sched = sv.ContinuousBatchingScheduler(eng2, log_interval=10 ** 9,
+                                           prefill_budget=11)
+    sched.submit(sv.Request("edge", toks[:89], max_new_tokens=2))
+    out = sched.run()["edge"].tokens
+    want = list(toks[:89])
+    for t in out[:1]:
+        assert t == int(jnp.argmax(_padded_ref(full_fwd, params, want,
+                                               pad_to=small)))
+        want.append(t)
+
+
+def test_chunk_split_never_changes_bits(model, params):
+    """The same prompt through one-shot prefill vs manual uneven chunks
+    yields the SAME logits bit-for-bit — chunk boundaries are an
+    implementation detail, not a numerics knob."""
+    toks = _prompt(n=40)
+    eng1 = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=64)
+    one = eng1.prefill(0, toks)
+    eng2 = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=64)
+    for lo, hi in ((0, 3), (3, 20), (20, 33), (33, 40)):
+        chunked = eng2.prefill_chunk(0, toks[lo:hi])
+    assert bool(jnp.all(one == chunked))
+    assert eng2.lengths()[0] == 40
+
+
+def test_mixed_prompt_length_drain_bounded_compiles_fifo(model, params):
+    """ISSUE-7 satellite: a mixed drain over lengths 1, 63, 64, 65,
+    prefill_len and > prefill_len — bounded prefill compiles (the
+    bucket table), FIFO no-starvation, every stream completes."""
+    eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                          prefill_len=80,
+                          prefill_buckets=(8, 16, 32, 64, 80))
+    first_chunks = []
+    orig_chunk = eng.prefill_chunk
+
+    def spy_chunk(slot, tokens):
+        if eng.lengths()[slot] == 0:      # first chunk == admission
+            first_chunks.append(tuple(tokens[:4]))
+        return orig_chunk(slot, tokens)
+
+    eng.prefill_chunk = spy_chunk
+    sched = sv.ContinuousBatchingScheduler(eng, max_queue=8,
+                                           log_interval=10 ** 9,
+                                           prefill_budget=32)
+    lens = [1, 63, 64, 65, 80, 90]        # 80 == prefill_len, 90 > it
+    reqs = [sv.Request(f"r{i}", _prompt(seed=i, n=n), max_new_tokens=3)
+            for i, n in enumerate(lens)]
+    for r in reqs:
+        sched.submit(r)
+    results = sched.run()
+    assert sorted(results) == sorted(r.rid for r in reqs)
+    for r in reqs:
+        assert len(results[r.rid].tokens) == 3
+        assert results[r.rid].finish_reason == "length"
+    # FIFO: first chunks dispatch in submission order (no starvation)
+    assert first_chunks == [tuple(r.prompt[:4]) for r in reqs]
+    # compile count bounded by the bucket table, asserted not hoped
+    assert eng.prefill_compiles() <= len(eng.prefill_buckets)
+    assert eng.decode_compiles() == 1
+    assert sched.prefill_backlog == 0
+
+
+def test_neighbor_slot_bit_identical_during_interleaved_chunked_prefill(
+        model, params):
+    """While a long prompt prefills chunk-by-chunk in slot 1, stream A
+    keeps decoding in slot 0 — and its per-step logits must not move by
+    a single bit vs decoding alone (chunk writes touch only their own
+    slot; interleaving is scheduling, not numerics)."""
+    def run_a(interleave):
+        eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                              prefill_len=16)
+        a_logits = eng.prefill(0, _prompt(seed=1))
+        long_prompt = _prompt(seed=9, n=64)
+        out = []
+        for step in range(12):
+            if interleave and step < 4:   # one 16-token chunk per step
+                eng.prefill_chunk(
+                    1, long_prompt[step * 16:(step + 1) * 16])
+            nxt = int(jnp.argmax(a_logits))
+            a_logits = eng.decode(np.array([nxt, 0], np.int32),
+                                  np.array([True, False]))[0]
+            out.append(np.asarray(a_logits))
+        return out
+
+    solo = run_a(interleave=False)
+    interleaved = run_a(interleave=True)
+    for t, (a, b) in enumerate(zip(solo, interleaved)):
+        assert np.array_equal(a, b), (
+            f"stream A diverged at step {t} during neighbor prefill")
+
+
+def test_prefill_budget_defers_work_and_reports_backlog(model, params):
+    """A 40-token prompt under an 8-token/step budget takes 5 steps to
+    cache: the deferred remainder is visible as prefill_backlog (and
+    the obs gauge), the first token arrives only when the prompt
+    completes, and decode of a live stream proceeds every step."""
+    from apex_tpu.obs import bridge as obs_bridge
+
+    eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                          prefill_len=16, prefill_buckets=(8, 16))
+    sched = sv.ContinuousBatchingScheduler(eng, log_interval=10 ** 9,
+                                           prefill_budget=8)
+    sched.submit(sv.Request("short", _prompt(seed=0, n=4),
+                            max_new_tokens=16))
+    sched.step()                          # short fully cached + tok 1
+    assert sched.phase_of("short") is sv.RequestPhase.DECODE
+    sched.submit(sv.Request("long", _prompt(seed=1, n=40),
+                            max_new_tokens=2))
+    backlogs = []
+    first_at = None
+    for i in range(8):
+        sched.step()
+        backlogs.append(sched.prefill_backlog)
+        if first_at is None and sched.phase_of("long") in (
+                sv.RequestPhase.DECODE, sv.RequestPhase.DONE):
+            first_at = i
+    # 40 tokens / 8-token budget -> 5 steps of chunks; backlog counts
+    # down 32, 24, 16, 8, 0 while "short" keeps decoding throughout
+    assert backlogs[:5] == [32, 24, 16, 8, 0]
+    assert first_at == 4
+    assert obs_bridge.SERVING_PREFILL_BACKLOG.value() == 0.0
+    results = sched.run()
+    assert len(results["long"].tokens) == 2
+    assert len(results["short"].tokens) == 16
 
 
 # ---------------------------------------------------------------------------

@@ -359,9 +359,11 @@ unrecovered failure in the same escalation ladder as every other fault.
 """,
     "serving": """\
 Serve a trained Llama from its resilience checkpoints: slotted KV-cached
-incremental decode plus continuous batching, with exactly two compiled
-device programs after warmup.  Every path below runs under tier-1 on CPU
-(`tests/test_serving.py`), including the bit-parity acceptance run.
+incremental decode plus continuous batching, with a *bounded* set of
+compiled device programs after warmup — one prefill program per bucket
+in a small power-of-two table, one batched decode step.  Every path
+below runs under tier-1 on CPU (`tests/test_serving.py`), including the
+bit-parity acceptance runs.
 
 ## Cache layout
 
@@ -372,58 +374,117 @@ k, v:     [layers, slots, max_len, kv_heads, head_dim]
 lengths:  [slots]  int32   # valid tokens per slot; 0 = free
 ```
 
-One slot per in-flight request.  Prefill writes a whole (padded) prompt
-with one `lax.dynamic_update_slice`; each decode step appends one token
-per slot at that slot's own depth (a vmapped dynamic-update — per-slot
-positions drift apart freely under continuous batching without changing
-any shape).  Attention always reads the full `max_len` axis under a
-per-slot length mask whose masked scores sit at the flash kernels'
-exact `-1e30`: `exp(masked - max)` underflows to exactly `0.0`, so the
-fixed-extent softmax is *bit-identical* to a same-extent uncached
-forward — masking is correctness, not approximation.  Bytes past
-`lengths` (prompt padding, evicted streams) are garbage by contract and
-unreadable by construction.
+One slot per in-flight request.  Prefill writes a (padded) prompt chunk
+at the slot's current depth with one per-row scatter (`mode="drop"`:
+bucket padding overhanging the cache end is dropped, never clamped
+backward onto cached tokens); each decode step appends one token per
+slot at that slot's own depth (a vmapped dynamic-update — per-slot
+positions drift apart freely under continuous batching without
+changing any shape).  Attention always
+reads the full `max_len` axis under a per-row visibility bound whose
+masked scores sit at the flash kernels' exact `-1e30`:
+`exp(masked - max)` underflows to exactly `0.0`, so the fixed-extent
+softmax is *bit-identical* to a same-extent uncached forward — masking
+is correctness, not approximation.  Bytes past `lengths` (chunk
+padding, evicted streams) are garbage by contract and unreadable by
+construction.
 
-## Slot lifecycle
+## The prefill bucket table
+
+`DecodeEngine(prefill_len=..., prefill_buckets=None)` derives a
+power-of-two chunk-size table (`default_prefill_buckets`: 16, 32, …,
+`prefill_len`; pass an explicit ascending tuple to override).  A prompt
+chunk is padded to the *smallest covering bucket*, so a 20-token prompt
+rides a 32-row dispatch instead of a `prefill_len`-row one — and the
+number of compiled prefill programs is bounded by `len(buckets)`
+(logarithmic in `prefill_len`), exposed as
+`DecodeEngine.prefill_compiles()` and **asserted** by tier-1 and the
+bench regression guard, not hoped.  Which bucket a prompt lands in
+never changes a bit of its logits (see below).
+
+## Chunked cached prefill (prompts past `prefill_len`)
+
+A prompt longer than `prefill_len` (up to cache capacity `max_len`) is
+split into `prefill_len`-sized chunks plus a bucketed tail.  Each
+chunk's causal block attends the **whole masked cache** — its own rows
+under `idx <= offset + row`, plus every previously cached token —
+through the same fixed-`max_len`-extent attention the decode step uses,
+then writes its K/V at the slot's offset.  Because every reduction runs
+at the same static extent as the shape-stable uncached forward, chunked
+prefill is **bit-identical** to prefilling in one shot *and* to the
+uncached forward: chunk boundaries are scheduling, not numerics
+(tier-1 pins a 70-token prompt through a 16-token chunk engine,
+bit-for-bit, prefill and the whole greedy decode stream).
+
+Cost model, stated honestly: a chunk's attention reads the **full
+`max_len` cache axis** (that fixed extent *is* the bit-exactness and
+no-recompile mechanism, shared with decode), so per-chunk attention is
+`O(bucket x max_len)` where the old single-program prefill paid
+`O(prefill_len^2)` causal.  The projections/MLP/LM-head — the dominant
+cost at transformer widths — scale with the *bucket*, which is what
+bucketing shrinks.  At `max_len >> prefill_len` the attention term
+grows; a length-bucketed cache *read* window would recover it but
+changes reduction extents (= forfeits bit-exactness vs the
+shape-stable forward) and multiplies the compile table — deliberately
+out of scope here.
+
+## Slot lifecycle and the prefill budget
 
 `QUEUED → PREFILL → DECODE → DONE`.  The scheduler admits queued
 requests into free slots at each step boundary (FIFO — a request's wait
-is bounded by the streams ahead of it, so no starvation), runs one
-shared batched decode step for every active slot, and evicts on EOS or
-`max_new_tokens` with **O(1)** slot release (zero the length, reuse
-immediately; the next prefill overwrites).  Admission, eviction, and
+is bounded by the streams ahead of it, so no starvation), spends at
+most `prefill_budget` prompt tokens on prefill chunks (oldest admitted
+request first; default = `engine.prefill_len`, one full-size chunk),
+runs one shared batched decode step for every decoding slot, and
+evicts on EOS or `max_new_tokens` with **O(1)** slot release (zero the
+length, reuse immediately; the next prefill overwrites).  The budget is
+the head-of-line-blocking knob: a long admission advances chunk-by-chunk
+*between* decode steps instead of stalling live streams for its whole
+prefill, and the deferred remainder is exported as the
+`apex_serving_prefill_backlog` gauge.  Admission, eviction, and
 sampling bookkeeping are host-side work at step boundaries — the device
-only ever sees the two compiled programs, and the decode step compiles
-**exactly once** (asserted via `jax.jit` cache stats in tier-1: no
-per-request retraces, the recompile tax the slotted cache exists to
+only ever sees the compiled programs, and the decode step compiles
+**exactly once** (asserted via `utils.compat.compile_count` in tier-1:
+no per-request retraces, the recompile tax the slotted cache exists to
 eliminate).
 
 ## Determinism guarantees
 
-- **Greedy decode is bit-identical to the uncached model**: the
-  acceptance test decodes 64+ tokens through the cache on a GQA config
-  and proves every step's f32 logits exactly equal to the shape-stable
-  uncached forward (context padded to `max_len`), and the greedy stream
+- **Prefill and greedy decode are bit-identical to the uncached
+  model**: the acceptance tests decode 64+ tokens through the cache on
+  a GQA config — after both one-shot and chunked prefill — and prove
+  every step's f32 logits exactly equal to the shape-stable uncached
+  forward (context padded to `max_len`), and the greedy stream
   identical to the unpadded forward.
+- **Chunk splits are invisible**: the same prompt through one-shot
+  prefill, even chunks, or uneven manual chunks yields the same logits
+  bit-for-bit.
 - **Sampling is a pure function** of `(logits, key, temperature,
   top_k)`: per-request PRNG keys derive as
   `fold_in(PRNGKey(seed), token_index)`, the clock feeds telemetry
   only, and a replay with the same seeds reproduces every stream
   bit-for-bit regardless of arrival timing or slot assignment.
-- **Streams are isolated**: evicting a neighbor slot and admitting a
-  new request into it mid-flight does not move any other stream's
-  logits by a single bit (tier-1 pins this).
+- **Streams are isolated**: evicting a neighbor slot, admitting a new
+  request into it mid-flight, or prefilling a long prompt chunk-by-chunk
+  next door does not move any other stream's logits by a single bit
+  (tier-1 pins all three).
 
 ## Telemetry
 
 Structured `emit_event` lines ride the `apex_tpu.events` logger:
 `serving_request_queued` / `serving_request_admitted` (queue depth),
-`serving_first_token` (TTFT), `serving_request_finished` (tokens/s,
-per-token latency, finish reason), and a periodic `serving_step` sample
-(queue depth, active slots).  `bench.py` captures a `serving` block —
-prefill tokens/s, steady-state decode ms/token, and continuous-batching
-aggregate throughput at 1/4/8 concurrent streams with staggered
-arrivals (4 concurrent streams ≥ 2× four sequential runs).
+`serving_prefill_chunk` (bucket size, chunk tokens, dispatch wall
+time — feeding the `apex_serving_prefill_duration_seconds{bucket}`
+histogram), `serving_first_token` (TTFT), `serving_request_finished`
+(tokens/s, per-token latency, finish reason), and a periodic
+`serving_step` sample (queue depth, active slots, prefill backlog).
+`bench.py` captures a `serving` block — prefill tokens/s, steady-state
+decode ms/token, continuous-batching aggregate throughput at 1/4/8
+concurrent streams with staggered arrivals (4 concurrent streams ≥ 2×
+four sequential runs), and a mixed-prompt-length workload where
+bucketed chunked prefill must beat the padded single-program baseline
+by ≥ 1.5× with `prefill_compiles` ≤ the bucket count and
+`decode_compiles == 1` (the compile-count regression guard).
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -471,12 +532,14 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_checkpoint_duration_seconds{op}` | histogram | save/validate/restore wall time |
 | `apex_checkpoints_rejected_total` | counter | `checkpoint_rejected` events |
 | `apex_serving_ttft_seconds` | histogram | `serving_first_token` events |
+| `apex_serving_prefill_duration_seconds{bucket}` | histogram | `serving_prefill_chunk` events (label = bucket size; bounded by the engine's bucket table) |
 | `apex_serving_decode_per_token_seconds` | histogram | `serving_request_finished` events |
 | `apex_serving_tokens_per_second` | gauge | last finished request |
 | `apex_serving_queue_depth` | gauge | scheduler, every step |
 | `apex_serving_slot_occupancy` | gauge | scheduler, every step |
 | `apex_serving_cache_utilization` | gauge | `DecodeEngine.cache_utilization()`, every step |
 | `apex_serving_decode_compiles` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
+| `apex_serving_prefill_backlog` | gauge | scheduler, every step (prompt tokens deferred by the prefill budget) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -745,7 +808,8 @@ Serve a trained checkpoint — start from the SAME resilience checkpoint
 root the training loop wrote (v1 whole-tree and v2 sharded both load;
 the newest *valid* step wins, exactly like a training restart), cast
 for bf16 serving through the amp policy, and run KV-cached continuous
-batching ([full page](api/serving.md)):
+batching with bucketed chunked prefill
+([full page](api/serving.md)):
 
 ```python
 from apex_tpu import amp, serving as sv
@@ -759,8 +823,15 @@ params, step = sv.load_serving_params(
     policy=amp.policy.O2())                        # bf16, norms fp32
 
 eng = sv.DecodeEngine(model, params, slots=8, max_len=2048,
-                      prefill_len=256)             # 2 compiled programs
-sched = sv.ContinuousBatchingScheduler(eng, max_queue=64)
+                      prefill_len=256)   # buckets (16, 32, 64, 128, 256):
+                                         # a short prompt costs a short
+                                         # dispatch; prompts up to 2048
+                                         # serve via chunked prefill
+sched = sv.ContinuousBatchingScheduler(
+    eng, max_queue=64,
+    prefill_budget=256)      # tokens of prefill per step: long
+                             # admissions advance chunk-by-chunk between
+                             # decode steps instead of stalling them
 sched.submit(sv.Request("r0", prompt_ids, max_new_tokens=128, eos_id=2,
                         temperature=0.7, top_k=40, seed=7))
 results = sched.run()          # rid -> RequestResult (tokens, TTFT, tps)
@@ -768,9 +839,13 @@ results = sched.run()          # rid -> RequestResult (tokens, TTFT, tps)
 
 Slots admit from the bounded FIFO queue at every step boundary and free
 on EOS/max-tokens with immediate reuse; the decode step compiles once
-and never retraces, no matter how requests arrive.  Greedy decode
-through the cache is bit-identical to the uncached forward (the tier-1
-acceptance test), and sampling replays exactly from its explicit seeds.
+and never retraces, and prefill compiles are bounded by the bucket
+table (both asserted through `utils.compat.compile_count`) no matter
+how requests arrive.  Prefill — one-shot, bucketed, or chunked past
+`prefill_len` — and greedy decode through the cache are bit-identical
+to the uncached forward (the tier-1 acceptance tests), sampling replays
+exactly from its explicit seeds, and deferred admission work is visible
+as the `apex_serving_prefill_backlog` gauge.
 
 Watch a training job live — the supervisor, checkpoint manager, and
 serving scheduler already publish into the default metrics registry

@@ -37,6 +37,12 @@ kill, corrupt, restart, converge — a tested code path:
   each global leaf and re-shards it onto the template's mesh — save on
   ``(dp=4, tp=2)``, resume bit-identically on ``(dp=2, tp=4)`` or
   ``dp=8`` (the elastic-restart contract).
+- :mod:`.async_checkpoint` — the asynchronous save pipeline: the step
+  loop blocks on ONE device→host snapshot, a background writer thread
+  runs the existing serialize/CRC/commit machinery (v1 and v2 managers
+  both), at most one write in flight, vetoable commit, failures
+  surfaced at the next step boundary — on-disk bytes identical to a
+  synchronous save (``SupervisorConfig(async_save=True)`` turns it on).
 - :mod:`.consistency` — cross-replica desync detection and repair:
   per-replica leaf hashes inside ``shard_map`` (only u32 digests cross
   the wire), structured localization of diverged leaves, resync by
@@ -72,12 +78,20 @@ gate ``mgr.save`` on ``jax.process_index() == 0`` (or give each process
 its own root); concurrent saves into one root race the temp-dir sweep.
 """
 
+from apex_tpu.resilience.async_checkpoint import (
+    AsyncCheckpointer,
+    SaveFuture,
+    SaveVetoed,
+)
 from apex_tpu.resilience.checkpoint import (
     CheckpointError,
     CheckpointManager,
+    LeafSnapshot,
+    TreeSnapshot,
     latest_valid_step,
     restore_checkpoint,
     save_checkpoint,
+    snapshot_tree,
     validate_checkpoint,
 )
 from apex_tpu.resilience.consistency import (
@@ -102,16 +116,19 @@ from apex_tpu.resilience.elastic import (
     ShardedCheckpointManager,
     restore_sharded_checkpoint,
     save_sharded_checkpoint,
+    snapshot_sharded_tree,
     validate_sharded_checkpoint,
 )
 from apex_tpu.resilience.fault_injection import (
     CorruptBatch,
     CorruptShardFile,
+    CrashCheckpointWriter,
     DesyncReplica,
     FaultInjector,
     FaultPlan,
     FlakyIterator,
     SimulatedPreemption,
+    SimulatedWriterCrash,
     SlowStep,
 )
 from apex_tpu.resilience.guarded import (
@@ -141,19 +158,27 @@ from apex_tpu.resilience.supervisor import (
 )
 
 __all__ = [
+    "AsyncCheckpointer",
+    "SaveFuture",
+    "SaveVetoed",
     "CheckpointError",
     "CheckpointManager",
+    "LeafSnapshot",
+    "TreeSnapshot",
     "latest_valid_step",
     "restore_checkpoint",
     "save_checkpoint",
+    "snapshot_tree",
     "validate_checkpoint",
     "CorruptBatch",
     "CorruptShardFile",
+    "CrashCheckpointWriter",
     "DesyncReplica",
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
     "SimulatedPreemption",
+    "SimulatedWriterCrash",
     "SlowStep",
     "DivergedLeaf",
     "ReplicaConsistency",
@@ -167,6 +192,7 @@ __all__ = [
     "ShardedCheckpointManager",
     "restore_sharded_checkpoint",
     "save_sharded_checkpoint",
+    "snapshot_sharded_tree",
     "validate_sharded_checkpoint",
     "GuardConfig",
     "GuardState",

@@ -34,17 +34,20 @@ same split as the reference's README "Checkpointing" recipe, where
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import json
 import os
 import shutil
 import tempfile
+import threading
 import time
 import zlib
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
+from jax.sharding import NamedSharding
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import metrics as obs_metrics
@@ -59,9 +62,12 @@ from apex_tpu.utils.serialization import (
 __all__ = [
     "CheckpointError",
     "CheckpointManager",
+    "LeafSnapshot",
+    "TreeSnapshot",
     "latest_valid_step",
     "restore_checkpoint",
     "save_checkpoint",
+    "snapshot_tree",
     "validate_checkpoint",
 ]
 
@@ -167,13 +173,54 @@ def _mesh_metadata(axis_sizes: Optional[dict] = None) -> Optional[dict]:
             "tp": axis_sizes.get("tp", 1), "pp": axis_sizes.get("pp", 1)}
 
 
+# Live-writer registry: while a (possibly background) writer is producing
+# a checkpoint, its temp dir must survive another save's orphan sweep and
+# its target step must survive rotation — the async pipeline serializes
+# saves through backpressure, but the emergency path and direct manager
+# calls share the root, so the protection is enforced here, at the one
+# place sweeping/rotation happen, not by caller discipline.
+_WRITERS_LOCK = threading.Lock()
+_ACTIVE_TMP_DIRS: set[str] = set()            # abs temp dirs being produced
+_ACTIVE_STEPS: set[tuple[str, int]] = set()   # (abs root, step) in flight
+
+
+@contextlib.contextmanager
+def _live_writer(root: str, step: int):
+    """Create this writer's temp dir and mark it live in ONE atomic
+    action (under ``_WRITERS_LOCK``, so a concurrent save's sweep can
+    never observe the dir unregistered), yield its path, and unregister
+    on exit, crashed or not — a crashed writer's litter becomes
+    sweepable the moment this exits."""
+    key = (os.path.abspath(root), int(step))
+    with _WRITERS_LOCK:
+        tmp_dir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
+        tmp_abs = os.path.abspath(tmp_dir)
+        _ACTIVE_TMP_DIRS.add(tmp_abs)
+        _ACTIVE_STEPS.add(key)
+    try:
+        yield tmp_dir
+    finally:
+        with _WRITERS_LOCK:
+            _ACTIVE_TMP_DIRS.discard(tmp_abs)
+            _ACTIVE_STEPS.discard(key)
+
+
 def _sweep_tmp_dirs(root: str) -> None:
-    """Reclaim ``tmp_*`` dirs orphaned by a hard kill mid-save.  Assumes
-    the single-writer root contract: any tmp dir present at save time is
-    dead weight rotation would never see."""
+    """Reclaim ``tmp_*`` dirs orphaned by a hard kill mid-save — except
+    the ones a live writer (e.g. an in-flight background save) is still
+    producing.  Liveness is re-checked under the lock per dir: creation
+    and registration are one atomic action in :func:`_live_writer`, so
+    a listed-but-unregistered dir is genuinely orphaned.  The
+    single-writer root contract still holds for *foreign* processes:
+    only this process's live writers are known."""
     for name in os.listdir(root):
-        if name.startswith(_TMP_PREFIX):
-            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        if not name.startswith(_TMP_PREFIX):
+            continue
+        full = os.path.abspath(os.path.join(root, name))
+        with _WRITERS_LOCK:
+            if full in _ACTIVE_TMP_DIRS:
+                continue
+        shutil.rmtree(full, ignore_errors=True)
 
 
 def _commit_step_dir(root: str, tmp_dir: str, final_dir: str) -> None:
@@ -186,18 +233,28 @@ def _commit_step_dir(root: str, tmp_dir: str, final_dir: str) -> None:
     new checkpoint is in place, and restored if the install fails.
     """
     aside = None
-    if os.path.exists(final_dir):
-        aside = tmp_dir + ".old"
-        os.rename(final_dir, aside)
     try:
-        os.replace(tmp_dir, final_dir)
-    except BaseException:
-        if aside is not None and not os.path.exists(final_dir):
-            os.rename(aside, final_dir)  # put the old checkpoint back
-        raise
-    _fsync_dir(root)
-    if aside is not None:
-        shutil.rmtree(aside, ignore_errors=True)
+        if os.path.exists(final_dir):
+            aside = tmp_dir + ".old"
+            # the aside name starts with tmp_ — register it as live
+            # BEFORE the rename so a concurrent writer's orphan sweep
+            # cannot reap the only copy of the old checkpoint mid-swap
+            with _WRITERS_LOCK:
+                _ACTIVE_TMP_DIRS.add(os.path.abspath(aside))
+            os.rename(final_dir, aside)
+        try:
+            os.replace(tmp_dir, final_dir)
+        except BaseException:
+            if aside is not None and not os.path.exists(final_dir):
+                os.rename(aside, final_dir)  # put the old checkpoint back
+            raise
+        _fsync_dir(root)
+        if aside is not None:
+            shutil.rmtree(aside, ignore_errors=True)
+    finally:
+        if aside is not None:
+            with _WRITERS_LOCK:
+                _ACTIVE_TMP_DIRS.discard(os.path.abspath(aside))
 
 
 def _rotate(root: str, keep: int, protect_step: int) -> None:
@@ -213,11 +270,236 @@ def _rotate(root: str, keep: int, protect_step: int) -> None:
     steps = _list_steps(root)
     sound = [s for s in steps
              if _quick_valid(os.path.join(root, _step_dirname(s)))]
-    retain = set(sound[-keep:]) | {int(protect_step)}
+    # keep-last-K counts only COMMITTED dirs (_list_steps never sees a
+    # temp dir), and a step an in-flight background write is still
+    # producing is never deleted — without this, an emergency save's
+    # rotation could reap the dir the writer is about to commit onto
+    with _WRITERS_LOCK:
+        in_flight = {s for r, s in _ACTIVE_STEPS
+                     if r == os.path.abspath(root)}
+    retain = set(sound[-keep:]) | {int(protect_step)} | in_flight
     for old in steps:
         if old not in retain:
             shutil.rmtree(os.path.join(root, _step_dirname(old)),
                           ignore_errors=True)
+
+
+# --------------------------------------------------------------------------
+# snapshot (the only phase an async save ever blocks the step loop on)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LeafSnapshot:
+    """One leaf, captured on the host: keystr path, an owned (or, for
+    the in-line sync path, borrowed) numpy array, the PRNG-key flag, and
+    the leaf's :class:`~jax.sharding.NamedSharding` partition spec when
+    it had one — everything a writer needs so that nothing about the
+    LIVE training state is read after the snapshot returns."""
+
+    path: str
+    array: np.ndarray
+    prng_key: bool = False
+    spec: Any = None  # Optional[jax.sharding.PartitionSpec]
+
+
+@dataclasses.dataclass
+class TreeSnapshot:
+    """A host-side copy of a whole pytree plus the metadata a background
+    writer needs (mesh stamp, shard-grid axis sizes).  Produced by
+    :func:`snapshot_tree` / the managers' ``snapshot`` methods; consumed
+    by their ``write_snapshot`` methods (possibly on another thread)."""
+
+    leaves: list
+    mesh: Optional[dict] = None          # manifest "mesh" stamp
+    axis_sizes: Optional[dict] = None    # shard grid (sharded saves only)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(leaf.array.nbytes for leaf in self.leaves)
+
+
+def _may_alias_live_state(leaf: Any) -> bool:
+    """Can ``device_get(leaf)`` hand back memory the training loop might
+    mutate?  Accelerator-resident ``jax.Array``s DMA into a fresh owned
+    host buffer (no aliasing); host-platform arrays may come back as a
+    VIEW of the live buffer, and plain ndarray leaves come back as the
+    caller's own object — those must be copied for donation safety."""
+    if isinstance(leaf, jax.Array):
+        try:
+            return any(d.platform == "cpu" for d in leaf.devices())
+        except Exception as e:  # conservative: unknown placement -> copy
+            logger.debug("leaf placement probe failed (%s: %s) — copying",
+                         type(e).__name__, e)
+            return True
+    return True
+
+
+def _leaf_snapshots(tree: Any, *, copy: bool) -> list[LeafSnapshot]:
+    """Flatten + ONE batched device→host transfer (typed PRNG keys
+    unwrapped to raw key data).  ``copy=True`` guarantees owned host
+    buffers: leaves whose transfer may alias live memory (see
+    :func:`_may_alias_live_state`) get one extra host copy, so a donated
+    buffer can never be overwritten by the next step while a background
+    writer is still serializing it — while accelerator leaves stay a
+    single device→host transfer (no doubled blocking cost)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    host = jax.device_get(
+        [jax.random.key_data(l) if is_prng_key(l) else l for _, l in flat])
+    out = []
+    for (path, leaf), arr in zip(flat, host):
+        arr = np.asarray(arr)
+        if copy and _may_alias_live_state(leaf):
+            arr = np.array(arr, copy=True)
+        sharding = getattr(leaf, "sharding", None)
+        spec = sharding.spec if isinstance(sharding, NamedSharding) else None
+        out.append(LeafSnapshot(path=jax.tree_util.keystr(path), array=arr,
+                                prng_key=is_prng_key(leaf), spec=spec))
+    return out
+
+
+_AUTO_MESH = object()  # sentinel: None is a valid (absent) mesh stamp
+
+
+@_observed("snapshot")
+def snapshot_tree(tree: Any, *, mesh_meta: Any = _AUTO_MESH) -> TreeSnapshot:
+    """Snapshot ``tree`` to owned host memory — the fast, blocking phase
+    of an asynchronous save (``apex_checkpoint_duration_seconds``
+    ``{op="snapshot"}``).  Donation-safe: every leaf whose transfer
+    could alias live memory is copied (accelerator leaves are already a
+    fresh DMA — one transfer, not two), so the step loop may overwrite
+    or donate the live state the moment this returns while a background
+    writer serializes the snapshot.  ``mesh_meta``
+    overrides the manifest mesh stamp (the sharded snapshot passes its
+    axis-sizes-keyed record; default reads the installed parallel
+    state)."""
+    t0 = time.monotonic()
+    leaves = _leaf_snapshots(tree, copy=True)
+    snap = TreeSnapshot(
+        leaves=leaves,
+        mesh=_mesh_metadata() if mesh_meta is _AUTO_MESH else mesh_meta)
+    emit_event("checkpoint_snapshot", bytes=snap.nbytes,
+               n_leaves=len(leaves), t0=t0)
+    return snap
+
+
+# flush+fsync cadence for the payload stream: bounds dirty-page debt so
+# the final fsync (and the host page cache) never owes the whole
+# multi-GB payload at once — a background writer must not convert the
+# step loop's savings into one giant I/O stall at commit time
+_FSYNC_INTERVAL_BYTES = 64 * 2**20
+
+
+def _write_step_dir(root: str, step: int, payload: Callable, *,
+                    head_fields: dict,
+                    mesh_meta: Optional[dict],
+                    commit_gate: Optional[Callable[[], None]] = None,
+                    ) -> tuple[str, list, int]:
+    """The atomic-write scaffolding shared by BOTH formats (and by the
+    sync and background callers of each): orphan sweep, live-claimed
+    temp dir, payload streaming, fsynced manifest, vetoable commit,
+    hard-kill-aware cleanup.  One implementation, so a fix to the
+    crash/veto machinery cannot drift between v1 and v2.
+
+    ``payload(f) -> (records, nbytes)`` streams the data file and
+    returns the manifest leaf records; ``head_fields`` leads the
+    manifest (``format_version``, v2's ``sharded`` flag) so the on-disk
+    key order stays byte-identical to the historical writers.
+    ``commit_gate`` (async pipeline) runs immediately before the atomic
+    rename: raising there aborts the commit with the temp dir cleaned
+    up — the consistency-veto hook.  An exception carrying
+    ``preserve_partial_write=True`` (the simulated-hard-kill fault)
+    leaves the partial temp dir on disk exactly as a SIGKILL would —
+    never committable (temp names are invisible to ``_list_steps``),
+    reclaimed by the next save's orphan sweep.  Returns
+    ``(final_dir, records, nbytes)``; the caller rotates and emits its
+    format's ``checkpoint_saved`` event.
+    """
+    os.makedirs(root, exist_ok=True)
+    _sweep_tmp_dirs(root)
+    final_dir = os.path.join(root, _step_dirname(step))
+    with _live_writer(root, step) as tmp_dir:
+        try:
+            with open(os.path.join(tmp_dir, _DATA), "wb") as f:
+                records, nbytes = payload(f)
+                f.flush()
+                os.fsync(f.fileno())
+            manifest = {
+                **head_fields,
+                "step": int(step),
+                "data_nbytes": nbytes,
+                "mesh": mesh_meta,
+                "leaves": records,
+            }
+            with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
+                json.dump(manifest, f, indent=1)
+                f.flush()
+                os.fsync(f.fileno())
+            if commit_gate is not None:
+                commit_gate()
+            _commit_step_dir(root, tmp_dir, final_dir)
+        except BaseException as e:
+            if not getattr(e, "preserve_partial_write", False):
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+            raise
+    return final_dir, records, nbytes
+
+
+def _write_checkpoint(root: str, step: int, leaves: list[LeafSnapshot], *,
+                      keep: int,
+                      mesh_meta: Optional[dict],
+                      t0: Optional[float] = None,
+                      commit_gate: Optional[Callable[[], None]] = None,
+                      progress_hook: Optional[Callable[[dict], None]] = None,
+                      event_fields: Optional[dict] = None) -> str:
+    """The v1 serialize/CRC machinery over :func:`_write_step_dir`, fed
+    from host snapshots — shared verbatim by the sync save and the
+    background writer, so the two paths cannot drift a byte.
+    ``progress_hook`` fires after every leaf record (fault injection /
+    tests)."""
+    t0 = time.monotonic() if t0 is None else t0
+
+    def payload(f):
+        # stream leaves straight to disk (no second in-RAM bytes copy
+        # of a potentially multi-GB state), offsets/CRCs as we go,
+        # fsync incrementally so a crash mid-write leaves bounded
+        # unsynced bytes in a dir that was never committable anyway
+        records, offset, unsynced = [], 0, 0
+        for i, snap in enumerate(leaves):
+            arr = snap.array
+            # ONE bytes copy per leaf: CRC and write share it.  (NB
+            # shape is recorded from `arr`, not the contiguous copy —
+            # ascontiguousarray promotes 0-d to 1-d.)
+            data = np.ascontiguousarray(arr).tobytes()
+            records.append({
+                "path": snap.path,
+                "shape": list(arr.shape),
+                "dtype": arr.dtype.name,
+                "prng_key": snap.prng_key,  # informational only
+                "offset": offset,
+                "nbytes": len(data),
+                "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+            })
+            f.write(data)
+            offset += len(data)
+            unsynced += len(data)
+            if unsynced >= _FSYNC_INTERVAL_BYTES:
+                f.flush()
+                os.fsync(f.fileno())
+                unsynced = 0
+            if progress_hook is not None:
+                progress_hook({"step": int(step), "record": i,
+                               "path": snap.path, "bytes": offset})
+        return records, offset
+
+    final_dir, _, nbytes = _write_step_dir(
+        root, step, payload,
+        head_fields={"format_version": _FORMAT_VERSION},
+        mesh_meta=mesh_meta, commit_gate=commit_gate)
+    _rotate(root, keep, protect_step=int(step))
+    emit_event("checkpoint_saved", step=int(step), bytes=nbytes,
+               path=final_dir, t0=t0, **(event_fields or {}))
+    return final_dir
 
 
 @_observed("save")
@@ -230,66 +512,17 @@ def save_checkpoint(root: str, step: int, tree: Any, *, keep: int = 3) -> str:
     between any two of these leaves a restorable set on disk.
 
     ``root`` must have a SINGLE writer: the orphan sweep below reclaims
-    every ``tmp_*`` dir, so a concurrent saver's in-progress temp dir
-    would be deleted out from under it.  In multi-controller runs gate
-    the save on ``jax.process_index() == 0`` or give each process its
-    own root.
+    every ``tmp_*`` dir this process is not actively producing, so a
+    concurrent foreign saver's in-progress temp dir would be deleted out
+    from under it.  In multi-controller runs gate the save on
+    ``jax.process_index() == 0`` or give each process its own root.
+    In-process, :class:`~apex_tpu.resilience.async_checkpoint.AsyncCheckpointer`
+    serializes background writes against this path by construction.
     """
     t0 = time.monotonic()
-    os.makedirs(root, exist_ok=True)
-    _sweep_tmp_dirs(root)
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    # ONE batched transfer for the whole tree, not a blocking device_get
-    # round-trip per leaf (typed PRNG keys unwrapped to raw key data)
-    host_leaves = jax.device_get(
-        [jax.random.key_data(l) if is_prng_key(l) else l for _, l in flat])
-    host_leaves = [np.asarray(a) for a in host_leaves]
-
-    final_dir = os.path.join(root, _step_dirname(step))
-    tmp_dir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
-    try:
-        # stream leaves straight to disk (no second in-RAM bytes copy of
-        # a potentially multi-GB state), recording offsets/CRCs as we go
-        records, offset = [], 0
-        with open(os.path.join(tmp_dir, _DATA), "wb") as f:
-            for (path, leaf), arr in zip(flat, host_leaves):
-                # ONE bytes copy per leaf: CRC and write share it.  (NB
-                # shape is recorded from `arr`, not the contiguous copy —
-                # ascontiguousarray promotes 0-d scalars to 1-d.)
-                data = np.ascontiguousarray(arr).tobytes()
-                records.append({
-                    "path": jax.tree_util.keystr(path),
-                    "shape": list(arr.shape),
-                    "dtype": arr.dtype.name,
-                    "prng_key": is_prng_key(leaf),  # informational only
-                    "offset": offset,
-                    "nbytes": len(data),
-                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
-                })
-                f.write(data)
-                offset += len(data)
-            f.flush()
-            os.fsync(f.fileno())
-        manifest = {
-            "format_version": _FORMAT_VERSION,
-            "step": int(step),
-            "data_nbytes": offset,
-            "mesh": _mesh_metadata(),
-            "leaves": records,
-        }
-        with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        _commit_step_dir(root, tmp_dir, final_dir)
-    except BaseException:
-        shutil.rmtree(tmp_dir, ignore_errors=True)
-        raise
-
-    _rotate(root, keep, protect_step=int(step))
-    emit_event("checkpoint_saved", step=int(step), bytes=offset,
-               path=final_dir, t0=t0)
-    return final_dir
+    leaves = _leaf_snapshots(tree, copy=False)
+    return _write_checkpoint(root, step, leaves, keep=keep,
+                             mesh_meta=_mesh_metadata(), t0=t0)
 
 
 def _read_manifest(ckpt_dir: str) -> dict:
@@ -548,6 +781,36 @@ class CheckpointManager:
         return self._retrying(
             lambda: save_checkpoint(self.root, step, tree, keep=self.keep),
             "checkpoint_save")
+
+    # -- the async pipeline's two-phase surface ---------------------------
+    # (apex_tpu.resilience.async_checkpoint calls snapshot() on the step
+    # loop's thread and write_snapshot() on the writer thread; together
+    # they produce the EXACT bytes save() would — same machinery)
+
+    def snapshot(self, tree: Any, *, specs: Any = None) -> TreeSnapshot:
+        """Host snapshot of ``tree`` (blocking, fast, donation-safe).
+        ``specs`` is accepted for drop-in symmetry with the sharded
+        manager and must be None here."""
+        if specs is not None:
+            raise ValueError(
+                "CheckpointManager.snapshot takes no partition specs — "
+                "use ShardedCheckpointManager for sharded saves")
+        return snapshot_tree(tree)
+
+    def write_snapshot(self, step: int, snapshot: TreeSnapshot, *,
+                       commit_gate: Optional[Callable[[], None]] = None,
+                       progress_hook: Optional[Callable[[dict], None]] = None,
+                       ) -> str:
+        """Serialize/commit a :class:`TreeSnapshot` (the slow phase; safe
+        to run on a background thread).  Applies the manager's ``retry``
+        policy exactly as :meth:`save` does."""
+        return self._retrying(
+            lambda: _write_checkpoint(
+                self.root, step, snapshot.leaves, keep=self.keep,
+                mesh_meta=snapshot.mesh, commit_gate=commit_gate,
+                progress_hook=progress_hook,
+                event_fields={"background": True}),
+            "checkpoint_write")
 
     def restore(self, like: Any, *, step: Optional[int] = None):
         return self._retrying(

@@ -44,37 +44,34 @@ from __future__ import annotations
 
 import dataclasses
 import itertools
-import json
 import os
-import shutil
-import tempfile
 import time
 import zlib
 from typing import Any, Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.resilience.checkpoint import (
     _DATA,
-    _MANIFEST,
+    _FSYNC_INTERVAL_BYTES,
     _SHARDED_FORMAT_VERSION,
-    _TMP_PREFIX,
     CheckpointError,
-    _commit_step_dir,
+    TreeSnapshot,
+    _leaf_snapshots,
     _list_steps,
     _mesh_metadata,
     _observed,
     _read_manifest,
     _rotate,
     _step_dirname,
-    _sweep_tmp_dirs,
+    _write_step_dir,
+    snapshot_tree,
 )
 from apex_tpu.resilience.consistency import _entry_names, _infer_mesh
 from apex_tpu.utils.serialization import (
-    is_prng_key,
     leaf_from_numpy,
     leaf_spec,
     np_dtype,
@@ -84,6 +81,7 @@ __all__ = [
     "ShardedCheckpointManager",
     "restore_sharded_checkpoint",
     "save_sharded_checkpoint",
+    "snapshot_sharded_tree",
     "validate_sharded_checkpoint",
 ]
 
@@ -102,17 +100,6 @@ def _spec_entries(spec, ndim: int) -> list[tuple[str, ...]]:
     return [_entry_names(spec[d] if spec is not None and d < len(spec)
                          else None)
             for d in range(ndim)]
-
-
-def _leaf_partition_spec(leaf: Any, override) -> Optional[P]:
-    """The spec a leaf is saved under: an explicit override wins, else
-    the leaf's own NamedSharding spec, else fully replicated."""
-    if override is not None:
-        return override
-    sharding = getattr(leaf, "sharding", None)
-    if isinstance(sharding, NamedSharding):
-        return sharding.spec
-    return None
 
 
 def _shard_grid(entries: Sequence[tuple[str, ...]], shape: Sequence[int],
@@ -174,6 +161,106 @@ def _spec_json(entries: Sequence[tuple[str, ...]]) -> list:
 # --------------------------------------------------------------------------
 
 
+def _resolve_spec_overrides(leaves: list, specs: Any) -> None:
+    """Fold an explicit ``specs`` pytree (PartitionSpecs / None entries)
+    into the snapshot leaves' captured shardings, in place.  After this,
+    the snapshot is self-contained: the writer never looks at the live
+    tree again."""
+    if specs is None:
+        return
+    spec_leaves = jax.tree.leaves(
+        specs, is_leaf=lambda x: x is None or isinstance(x, P))
+    if len(spec_leaves) != len(leaves):
+        raise ValueError(
+            f"specs has {len(spec_leaves)} leaves for a tree of "
+            f"{len(leaves)} (pass a matching pytree of PartitionSpecs)")
+    for snap, override in zip(leaves, spec_leaves):
+        if override is not None:
+            snap.spec = override
+
+
+def snapshot_sharded_tree(tree: Any, *, mesh: Optional[Mesh] = None,
+                          specs: Any = None) -> TreeSnapshot:
+    """Host snapshot for a *sharded* save: owned leaf copies plus the
+    shard-grid geometry (mesh axis sizes, per-leaf partition specs)
+    captured NOW, from the live leaves — a background writer must not
+    read shardings off device arrays the step loop has since donated."""
+    if mesh is None:
+        mesh = _infer_mesh(tree, required=False)
+    axis_sizes = _mesh_axis_sizes(mesh)
+    snap = snapshot_tree(tree,
+                         mesh_meta=_mesh_metadata(axis_sizes or None))
+    _resolve_spec_overrides(snap.leaves, specs)
+    snap.axis_sizes = axis_sizes
+    return snap
+
+
+def _write_sharded_checkpoint(root: str, step: int, leaves: list, *,
+                              axis_sizes: dict,
+                              mesh_meta: Optional[dict],
+                              keep: int,
+                              t0: Optional[float] = None,
+                              commit_gate=None,
+                              progress_hook=None,
+                              event_fields: Optional[dict] = None) -> str:
+    """The v2 shard-grid serialize/CRC machinery over the shared
+    ``checkpoint._write_step_dir`` scaffolding (sweep, live temp dir,
+    vetoable commit, hard-kill cleanup — ONE implementation for both
+    formats), fed from host snapshots and shared by the sync save and
+    the background writer.  ``progress_hook`` fires per leaf record;
+    shard records are fsynced incrementally."""
+    t0 = time.monotonic() if t0 is None else t0
+
+    def payload(f):
+        records, offset, unsynced = [], 0, 0
+        for i, snap in enumerate(leaves):
+            arr = snap.array
+            entries = _spec_entries(snap.spec, arr.ndim)
+            shards = []
+            for coords, index in _shard_grid(entries, arr.shape,
+                                             axis_sizes, snap.path):
+                block = arr[tuple(slice(lo, hi) for lo, hi in index)]
+                data = np.ascontiguousarray(block).tobytes()
+                shards.append({
+                    "coords": coords,
+                    "index": index,
+                    "offset": offset,
+                    "nbytes": len(data),
+                    "crc32": zlib.crc32(data) & 0xFFFFFFFF,
+                })
+                f.write(data)
+                offset += len(data)
+                unsynced += len(data)
+                if unsynced >= _FSYNC_INTERVAL_BYTES:
+                    f.flush()
+                    os.fsync(f.fileno())
+                    unsynced = 0
+            records.append({
+                "path": snap.path,
+                "shape": list(arr.shape),  # GLOBAL shape
+                "dtype": arr.dtype.name,
+                "prng_key": snap.prng_key,
+                "spec": _spec_json(entries),
+                "shards": shards,
+            })
+            if progress_hook is not None:
+                progress_hook({"step": int(step), "record": i,
+                               "path": snap.path, "bytes": offset})
+        return records, offset
+
+    final_dir, records, nbytes = _write_step_dir(
+        root, step, payload,
+        head_fields={"format_version": _SHARDED_FORMAT_VERSION,
+                     "sharded": True},
+        mesh_meta=mesh_meta, commit_gate=commit_gate)
+    _rotate(root, keep, protect_step=int(step))
+    emit_event("checkpoint_saved", step=int(step), bytes=nbytes,
+               path=final_dir, sharded=True,
+               n_shards=sum(len(r["shards"]) for r in records), t0=t0,
+               **(event_fields or {}))
+    return final_dir
+
+
 @_observed("save")
 def save_sharded_checkpoint(root: str, step: int, tree: Any, *,
                             mesh: Optional[Mesh] = None,
@@ -190,83 +277,14 @@ def save_sharded_checkpoint(root: str, step: int, tree: Any, *,
     the single-writer root assumption.
     """
     t0 = time.monotonic()
-    os.makedirs(root, exist_ok=True)
-    _sweep_tmp_dirs(root)
     if mesh is None:
         mesh = _infer_mesh(tree, required=False)
     axis_sizes = _mesh_axis_sizes(mesh)
-
-    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    if specs is not None:
-        spec_leaves = jax.tree.leaves(
-            specs, is_leaf=lambda x: x is None or isinstance(x, P))
-        if len(spec_leaves) != len(flat):
-            raise ValueError(
-                f"specs has {len(spec_leaves)} leaves for a tree of "
-                f"{len(flat)} (pass a matching pytree of PartitionSpecs)")
-    else:
-        spec_leaves = [None] * len(flat)
-    # ONE batched transfer for the whole tree (typed PRNG keys unwrapped)
-    host_leaves = jax.device_get(
-        [jax.random.key_data(l) if is_prng_key(l) else l for _, l in flat])
-    host_leaves = [np.asarray(a) for a in host_leaves]
-
-    final_dir = os.path.join(root, _step_dirname(step))
-    tmp_dir = tempfile.mkdtemp(prefix=_TMP_PREFIX, dir=root)
-    try:
-        records, offset = [], 0
-        with open(os.path.join(tmp_dir, _DATA), "wb") as f:
-            for (path, leaf), arr, override in zip(flat, host_leaves,
-                                                   spec_leaves):
-                key = jax.tree_util.keystr(path)
-                spec = _leaf_partition_spec(leaf, override)
-                entries = _spec_entries(spec, arr.ndim)
-                shards = []
-                for coords, index in _shard_grid(entries, arr.shape,
-                                                 axis_sizes, key):
-                    block = arr[tuple(slice(lo, hi) for lo, hi in index)]
-                    data = np.ascontiguousarray(block).tobytes()
-                    shards.append({
-                        "coords": coords,
-                        "index": index,
-                        "offset": offset,
-                        "nbytes": len(data),
-                        "crc32": zlib.crc32(data) & 0xFFFFFFFF,
-                    })
-                    f.write(data)
-                    offset += len(data)
-                records.append({
-                    "path": key,
-                    "shape": list(arr.shape),  # GLOBAL shape
-                    "dtype": arr.dtype.name,
-                    "prng_key": is_prng_key(leaf),
-                    "spec": _spec_json(entries),
-                    "shards": shards,
-                })
-            f.flush()
-            os.fsync(f.fileno())
-        manifest = {
-            "format_version": _SHARDED_FORMAT_VERSION,
-            "sharded": True,
-            "step": int(step),
-            "data_nbytes": offset,
-            "mesh": _mesh_metadata(axis_sizes or None),
-            "leaves": records,
-        }
-        with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
-            json.dump(manifest, f, indent=1)
-            f.flush()
-            os.fsync(f.fileno())
-        _commit_step_dir(root, tmp_dir, final_dir)
-    except BaseException:
-        shutil.rmtree(tmp_dir, ignore_errors=True)
-        raise
-
-    _rotate(root, keep, protect_step=int(step))
-    emit_event("checkpoint_saved", step=int(step), bytes=offset,
-               path=final_dir, sharded=True,
-               n_shards=sum(len(r["shards"]) for r in records), t0=t0)
-    return final_dir
+    leaves = _leaf_snapshots(tree, copy=False)
+    _resolve_spec_overrides(leaves, specs)
+    return _write_sharded_checkpoint(
+        root, step, leaves, axis_sizes=axis_sizes,
+        mesh_meta=_mesh_metadata(axis_sizes or None), keep=keep, t0=t0)
 
 
 # --------------------------------------------------------------------------
@@ -526,6 +544,28 @@ class ShardedCheckpointManager:
                                             mesh=self.mesh, specs=specs,
                                             keep=self.keep),
             "sharded_checkpoint_save")
+
+    # -- the async pipeline's two-phase surface (same contract as
+    #    CheckpointManager.snapshot/write_snapshot) ------------------------
+
+    def snapshot(self, tree: Any, *, specs: Any = None) -> TreeSnapshot:
+        """Host snapshot incl. shard-grid geometry (blocking, fast,
+        donation-safe)."""
+        return snapshot_sharded_tree(tree, mesh=self.mesh, specs=specs)
+
+    def write_snapshot(self, step: int, snapshot: TreeSnapshot, *,
+                       commit_gate=None, progress_hook=None) -> str:
+        """Serialize/commit a sharded :class:`TreeSnapshot` (the slow
+        phase; safe on a background thread), under the manager's
+        ``retry`` policy."""
+        return self._retrying(
+            lambda: _write_sharded_checkpoint(
+                self.root, step, snapshot.leaves,
+                axis_sizes=snapshot.axis_sizes or {},
+                mesh_meta=snapshot.mesh, keep=self.keep,
+                commit_gate=commit_gate, progress_hook=progress_hook,
+                event_fields={"background": True}),
+            "sharded_checkpoint_write")
 
     def restore(self, like: Any, *, step: Optional[int] = None):
         return self._retrying(

@@ -53,7 +53,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Any, Callable, Iterable, Iterator, Tuple, Type
+from typing import Any, Callable, Iterable, Iterator, Optional, Tuple, Type
 
 import jax
 import jax.numpy as jnp
@@ -64,11 +64,13 @@ from apex_tpu._logging import emit_event
 __all__ = [
     "CorruptBatch",
     "CorruptShardFile",
+    "CrashCheckpointWriter",
     "DesyncReplica",
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
     "SimulatedPreemption",
+    "SimulatedWriterCrash",
     "SlowStep",
 ]
 
@@ -435,6 +437,67 @@ class DesyncReplica:
         emit_event("fault_injected", fault="desync_replica", step=int(step),
                    leaf=key, rank=self.rank, element=pos, delta=self.delta)
         return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class SimulatedWriterCrash(RuntimeError):
+    """A checkpoint writer died mid-write (stands in for SIGKILL).
+
+    ``preserve_partial_write`` makes the write machinery skip its
+    temp-dir cleanup — exactly the on-disk state a hard kill leaves: a
+    partially written ``tmp_*`` dir that ``latest_valid_step`` and the
+    restore walk can never select, reclaimed by the next save's orphan
+    sweep.  Deterministic (``transient = False``): a crashed writer is
+    not an I/O blip, so the retry layer never re-runs it."""
+
+    preserve_partial_write = True
+    transient = False
+
+    def __init__(self, step: int, record: int):
+        super().__init__(
+            f"simulated writer crash at step {step}, record {record}")
+        self.step = step
+        self.record = record
+
+
+class CrashCheckpointWriter:
+    """Kill the (background) checkpoint writer after N leaf records.
+
+    Install as the write machinery's ``progress_hook`` (e.g.
+    ``AsyncCheckpointer(manager, progress_hook=CrashCheckpointWriter())``
+    or ``manager.write_snapshot(..., progress_hook=...)``): the hook
+    fires after each manifest record is written, and once
+    ``after_records`` records are on disk it raises
+    :class:`SimulatedWriterCrash` — leaving the partial temp dir behind
+    like a real SIGKILL (see ``preserve_partial_write``).  ``steps``
+    optionally restricts the crash to chosen host steps; one crash per
+    instance (``fired``), so a retried or subsequent save succeeds.
+    """
+
+    def __init__(self, *, after_records: int = 1,
+                 steps: Optional[Iterable[int]] = None):
+        if after_records < 1:
+            raise ValueError(
+                f"after_records must be >= 1, got {after_records}")
+        self.after_records = int(after_records)
+        self.steps = None if steps is None else frozenset(
+            int(s) for s in steps)
+        self.fired = False
+        self._seen = 0
+
+    def __call__(self, progress: dict) -> None:
+        if self.fired:
+            return
+        if self.steps is not None and int(progress["step"]) not in self.steps:
+            return
+        self._seen += 1
+        if self._seen >= self.after_records:
+            self.fired = True
+            emit_event("fault_injected", fault="writer_crash",
+                       step=int(progress["step"]),
+                       record=int(progress["record"]),
+                       bytes=int(progress["bytes"]))
+            raise SimulatedWriterCrash(int(progress["step"]),
+                                       int(progress["record"]))
 
 
 class CorruptShardFile:

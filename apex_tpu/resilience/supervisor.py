@@ -52,6 +52,7 @@ from typing import Any, Callable, Iterable, Optional, Tuple
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import metrics as obs_metrics
 from apex_tpu.obs import trace as obs_trace
+from apex_tpu.resilience.async_checkpoint import AsyncCheckpointer, SaveVetoed
 from apex_tpu.resilience.checkpoint import (
     CheckpointError,
     CheckpointManager,
@@ -409,7 +410,17 @@ class SupervisorConfig:
     :class:`~apex_tpu.resilience.consistency.ReplicaConsistency` pass
     every that many steps (0 disables); a desync the pass cannot repair
     escalates through the same failure ladder as every other
-    unrecovered failure."""
+    unrecovered failure.
+
+    ``async_save`` (default off — the sync path is the escape hatch and
+    the bit-identical reference) moves periodic checkpoint writes onto a
+    background thread: the step loop blocks only on the device→host
+    snapshot, at most one write is in flight (backpressure blocks the
+    *next* save, not the step), a failed write surfaces at the next step
+    boundary into the same retry/escalation ladder, emergency
+    checkpoints and shutdown join the in-flight write first, and a
+    failed consistency pass vetoes an in-flight commit.  On-disk bytes
+    and restores are identical to sync mode."""
 
     step_deadline_s: float = 1800.0
     poll_interval_s: Optional[float] = None
@@ -417,6 +428,14 @@ class SupervisorConfig:
     checkpoint_every: int = 1
     consistency_check_interval: int = 0
     heartbeat_path: Optional[str] = None
+    async_save: bool = False
+    # bound on joining a wedged background writer at escalation/shutdown:
+    # the graceful-degradation contract ("a wedged process is worse than
+    # a lost checkpoint interval") must hold against the writer too —
+    # past the bound the emergency save proceeds anyway (the live-writer
+    # registry makes the two writers safe concurrently) and the daemon
+    # writer dies with the process, its temp dir never committable
+    async_join_timeout_s: float = 120.0
     retry: RetryPolicy = RetryPolicy()
 
     def __post_init__(self):
@@ -428,6 +447,8 @@ class SupervisorConfig:
             raise ValueError("checkpoint_every must be >= 1")
         if self.consistency_check_interval < 0:
             raise ValueError("consistency_check_interval must be >= 0")
+        if self.async_join_timeout_s <= 0.0:
+            raise ValueError("async_join_timeout_s must be positive")
 
 
 class TrainingSupervisor:
@@ -480,6 +501,23 @@ class TrainingSupervisor:
         self.persist_transform = persist_transform
         self.consecutive_failures = 0
         self._sleep = sleep
+        # async pipeline: periodic saves become snapshot + background
+        # write; the emergency path stays synchronous (it must be
+        # durable before TrainingAborted is raised) but joins the
+        # in-flight write first — one writer per root, always
+        self._async = (AsyncCheckpointer(manager, retry=config.retry,
+                                         sleep=sleep)
+                       if config.async_save and manager is not None
+                       else None)
+        # step label of the newest checkpoint pointer published to the
+        # heartbeat: the shutdown drain must never overwrite a NEWER
+        # pointer (e.g. the emergency checkpoint escalate() just beat)
+        # with an older async commit
+        self._published_ckpt_step: Optional[int] = None
+        # did escalate() already perform the bounded in-flight join?  the
+        # finally drain must not pay a SECOND async_join_timeout_s on the
+        # very wedged-writer path the bound exists for
+        self._escalate_joined = False
         self.watchdog = StepWatchdog(
             config.step_deadline_s,
             heartbeat_path=config.heartbeat_path,
@@ -524,18 +562,85 @@ class TrainingSupervisor:
         checkpoint interval.
         """
         ckpt_step = step if completed_step is None else completed_step
-        path, ckpt_error = None, None
+        path, path_step, ckpt_error = None, None, None
+        if self._async is not None:
+            # join the in-flight background write FIRST (bounded: a
+            # writer wedged on dead storage must not block the abort
+            # forever — the live-writer registry keeps a concurrent
+            # emergency save safe): the emergency save must not race a
+            # healthy writer for the root, and the newest committed
+            # periodic path is a resume pointer worth carrying
+            joined = self._async.wait(
+                timeout=self.config.async_join_timeout_s)
+            self._escalate_joined = True
+            if joined is None and self._async.inflight is not None:
+                logger.warning(
+                    "background checkpoint write still running after "
+                    "%.0fs at escalation — proceeding with the "
+                    "emergency checkpoint", self.config.async_join_timeout_s)
+            lc = self._async.last_committed  # one atomic (step, path) read
+            if lc is not None:
+                path_step, path = lc
         if self.manager is not None:
+            fallback = (path, path_step)  # newest COMMITTED async pointer
             try:
                 path = self._checkpoint(ckpt_step, state,
                                         what="emergency_checkpoint")
                 validate_checkpoint(path)
+                path_step = int(ckpt_step)
             except (RetryExhausted, CheckpointError, OSError) as e:
                 ckpt_error = f"{type(e).__name__}: {e}"
+                # never publish a pointer that just failed validation —
+                # the abort carries the newest checkpoint known GOOD (or
+                # None), plus the error explaining what was lost
+                path, path_step = fallback
         emit_event("supervisor_abort", step=int(step), reason=reason,
                    checkpoint=path, checkpoint_error=ckpt_error)
         self.watchdog.beat(step, ckpt_path=path)
+        if path is not None:
+            self._note_published(path_step)
         raise TrainingAborted(reason, int(step), path)
+
+    def _beat_if_newer(self, at_step: int) -> None:
+        """Publish the async pipeline's newest committed checkpoint to
+        the heartbeat iff it is newer than anything already published.
+        ``at_step`` is the training step to label the beat with — the
+        heartbeat's ``step`` field must never run backwards just because
+        the checkpoint being published is older than the loop's last
+        beat."""
+        lc = self._async.last_committed  # one atomic (step, path) read
+        if lc is None:
+            return
+        lc_step, lc_path = lc
+        if (self._published_ckpt_step is not None
+                and lc_step <= self._published_ckpt_step):
+            return
+        self.watchdog.beat(max(int(at_step), lc_step), ckpt_path=lc_path)
+        self._note_published(lc_step)
+
+    def _consume_async_result(self, done, step: int, state: Any) -> None:
+        """THE harvest policy for one completed background write, shared
+        by the step-boundary poll and the return drain: a failure in the
+        supervisor's domain joins the ladder, a veto was deliberate and
+        already accounted by its cause, anything else propagates exactly
+        as a synchronous save error would.  Commits are published via
+        ``last_committed``, never here."""
+        if done is None or done.error is None:
+            return
+        if isinstance(done.error, self.FAILURE_DOMAIN):
+            self.record_failure(step, state, done.error)
+        elif not isinstance(done.error, SaveVetoed):
+            raise done.error
+
+    def _note_published(self, step: Optional[int]) -> None:
+        """Record the step label of the newest checkpoint pointer beaten
+        into the heartbeat — the guard that keeps the pointer monotonic
+        (a late drain must not regress it to an older commit)."""
+        if step is None:
+            return
+        if (self._published_ckpt_step is None
+                or int(step) > self._published_ckpt_step):
+            self._published_ckpt_step = int(step)
 
     # -- the supervised loop ----------------------------------------------
 
@@ -558,6 +663,15 @@ class TrainingSupervisor:
         never the dp-world-size-dependent stacked form."""
         if self.persist_transform is not None:
             state = self.persist_transform(state)
+        if self._async is not None and what == "checkpoint_save":
+            # periodic save under async_save: block on the snapshot only
+            # and hand the write to the background thread.  Returns None
+            # — the heartbeat's resume pointer advances when the commit
+            # is harvested at a later step boundary, never before the
+            # step dir is durably in place.  (The emergency path stays
+            # synchronous: durability before TrainingAborted.)
+            self._async.save(int(step), state)
+            return None
         if self.manager.retry is not None:
             return self.manager.save(int(step), state)
         return retry_transient(
@@ -578,6 +692,7 @@ class TrainingSupervisor:
         it = iter(batches)
         step = int(start_step)
         last_completed = step - 1
+        self._escalate_joined = False
         # STICKY across steps: once a consistency pass fails, the state
         # stays untrusted (no commit, no failure-counter reset) until a
         # later pass proves it clean — steps BETWEEN interval checks
@@ -645,6 +760,12 @@ class TrainingSupervisor:
                             # latest_valid_step and survive the restart
                             step_ok = False
                             state_trusted = False
+                            if self._async is not None:
+                                # an in-flight background write is from the
+                                # same untrusted lineage — veto its commit
+                                # before it can publish a step dir
+                                self._async.veto(
+                                    f"consistency failure at step {step}")
                             self.record_failure(step, state, e)
                     # the consecutive-failure counter resets only while the
                     # state is trusted — otherwise a desync that re-proves
@@ -655,15 +776,56 @@ class TrainingSupervisor:
 
                     # -- commit host-side progress
                     ckpt_path = None
+                    ckpt_path_step = step
+                    if self._async is not None:
+                        # harvest the background write that (maybe)
+                        # finished since the last boundary: a failure
+                        # joins the ladder exactly one step boundary
+                        # after it died
+                        self._consume_async_result(self._async.poll(),
+                                                   step, state)
+                        # the resume pointer is the newest COMMITTED
+                        # path — lossless even when a backpressure join
+                        # (not poll) consumed a success's future; one
+                        # atomic (step, path) read so the published
+                        # bookkeeping can never run ahead of the path
+                        lc = self._async.last_committed
+                        if lc is not None:
+                            ckpt_path_step, ckpt_path = lc
                     if self.manager is not None and state_trusted and (
                             (step + 1) % self.config.checkpoint_every == 0
                             or step + 1 >= num_steps):
                         try:
-                            ckpt_path = self._checkpoint(step, state)
+                            path = self._checkpoint(step, state)
+                            if path is not None:  # None: async, in flight
+                                ckpt_path = path
                         except RetryExhausted as e:
                             self.record_failure(step, state, e)  # may abort
                     self.watchdog.beat(step, ckpt_path=ckpt_path)
+                    if ckpt_path is not None:
+                        self._note_published(ckpt_path_step)
                     step += 1
+            # drain the final in-flight write BEFORE returning: the last
+            # periodic save must be durable — or its failure visible —
+            # when the caller moves on (bounded: a wedged writer must
+            # not wedge the return; it dies with the process, its temp
+            # dir never committable)
+            if self._async is not None:
+                done = self._async.wait(
+                    timeout=self.config.async_join_timeout_s)
+                self._consume_async_result(done, last_completed, state)
+                self._beat_if_newer(last_completed)
             return state, last_completed
         finally:
+            if self._async is not None:
+                # exception paths must not abandon a nearly committed
+                # write; the newest commit still reaches the resume
+                # pointer before the watchdog stops — but never by
+                # REGRESSING it, and never by paying a SECOND bounded
+                # join when escalate() already performed one on a
+                # wedged writer
+                if not self._escalate_joined:
+                    self._async.wait(
+                        timeout=self.config.async_join_timeout_s)
+                self._beat_if_newer(max(last_completed, 0))
             self.watchdog.stop()

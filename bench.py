@@ -206,16 +206,13 @@ def _run_external(name: str, *, batch, steps, seq) -> dict:
 _RECOVERY_BYTE_BUDGET = 64 * 2**20
 
 
-def _recovery_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET) -> dict:
-    """Checkpoint save/validate/restore wall time + bytes for ``tree``
-    (the BENCH_*.json ``recovery`` block; never fatal to the bench)."""
-    import shutil
-    import tempfile
-
-    from apex_tpu.resilience import checkpoint as ckpt
-
+def _budget_leaves(tree, byte_budget: int):
+    """Leaves of ``tree`` taken in order until ``byte_budget`` is hit
+    (a too-big FIRST leaf is sliced down — the budget is a hard cap);
+    returns ``(measured_tree, total_bytes, sampled)``.  Shared by the
+    ``recovery`` and ``ckpt_async`` diagnostic blocks."""
     leaves, total, sliced = [], 0, False
-    flat, treedef = jax.tree_util.tree_flatten(tree)
+    flat, _ = jax.tree_util.tree_flatten(tree)
     for leaf in flat:
         nbytes = int(np.prod(leaf.shape)) * leaf.dtype.itemsize \
             if hasattr(leaf, "shape") else 8
@@ -230,7 +227,19 @@ def _recovery_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET) -> dict:
             break
         leaves.append(leaf)
         total += nbytes
-    measured = dict(enumerate(leaves))
+    return (dict(enumerate(leaves)), total,
+            sliced or len(leaves) < len(flat))
+
+
+def _recovery_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET) -> dict:
+    """Checkpoint save/validate/restore wall time + bytes for ``tree``
+    (the BENCH_*.json ``recovery`` block; never fatal to the bench)."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.resilience import checkpoint as ckpt
+
+    measured, total, sampled = _budget_leaves(tree, byte_budget)
 
     root = tempfile.mkdtemp(prefix="bench_recovery_")
     try:
@@ -249,13 +258,78 @@ def _recovery_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET) -> dict:
     return {
         "ok": True,  # failure path emits ok: False — keep one schema
         "bytes": total,
-        "n_leaves": len(leaves),
-        "sampled": sliced or len(leaves) < len(flat),
+        "n_leaves": len(measured),
+        "sampled": sampled,
         "save_ms": round(t_save * 1e3, 2),
         "validate_ms": round(t_validate * 1e3, 2),
         "restore_ms": round(t_restore * 1e3, 2),
         "save_mb_per_s": round(total / 2**20 / max(t_save, 1e-9), 1),
         "restore_mb_per_s": round(total / 2**20 / max(t_restore, 1e-9), 1),
+    }
+
+
+def _ckpt_async_metrics(tree, byte_budget: int = _RECOVERY_BYTE_BUDGET,
+                        n_saves: int = 3) -> dict:
+    """Step-loop blocking cost of a periodic save, sync vs async (the
+    BENCH_*.json ``ckpt_async`` block, ISSUE 8): the sync number is the
+    full save wall time (the stall the step loop used to eat), the
+    async number is the snapshot alone — the background write runs off
+    the timed window and is reported separately.  Also proves the two
+    modes leave byte-identical files on disk.  Never fatal to the
+    bench."""
+    import shutil
+    import tempfile
+
+    from apex_tpu.resilience import checkpoint as ckpt
+    from apex_tpu.resilience.async_checkpoint import AsyncCheckpointer
+
+    measured, total, sampled = _budget_leaves(tree, byte_budget)
+    root_s = tempfile.mkdtemp(prefix="bench_ckpt_sync_")
+    root_a = tempfile.mkdtemp(prefix="bench_ckpt_async_")
+    try:
+        sync_ms, snap_ms, write_ms = [], [], []
+        for i in range(n_saves):
+            t0 = time.perf_counter()
+            ckpt.save_checkpoint(root_s, i, measured, keep=n_saves + 1)
+            sync_ms.append((time.perf_counter() - t0) * 1e3)
+        ac = AsyncCheckpointer(
+            ckpt.CheckpointManager(root_a, keep=n_saves + 1))
+        for i in range(n_saves):
+            t0 = time.perf_counter()
+            fut = ac.save(i, measured)
+            blocked = (time.perf_counter() - t0) * 1e3
+            fut.result()  # drain OUTSIDE the blocking window
+            snap_ms.append(blocked)
+            write_ms.append(fut.write_s * 1e3)
+        # the on-disk format must be byte-identical to sync mode —
+        # async is a scheduling change, not a format change
+        def _read(path):
+            with open(path, "rb") as f:
+                return f.read()
+
+        identical = all(
+            _read(os.path.join(root_s, d, n))
+            == _read(os.path.join(root_a, d, n))
+            for d in sorted(os.listdir(root_s)) if d.startswith("step_")
+            for n in ("manifest.json", "data.bin"))
+    finally:
+        shutil.rmtree(root_s, ignore_errors=True)
+        shutil.rmtree(root_a, ignore_errors=True)
+    blocking_sync = sorted(sync_ms)[len(sync_ms) // 2]     # median
+    blocking_async = sorted(snap_ms)[len(snap_ms) // 2]
+    return {
+        "ok": True,
+        "bytes": total,
+        "sampled": sampled,
+        "n_saves": n_saves,
+        "blocking_ms_per_save_sync": round(blocking_sync, 2),
+        "blocking_ms_per_save_async": round(blocking_async, 2),
+        "snapshot_ms": round(blocking_async, 2),
+        "write_ms_background": round(
+            sorted(write_ms)[len(write_ms) // 2], 2),
+        "blocking_reduction_x": round(
+            blocking_sync / max(blocking_async, 1e-9), 2),
+        "bytes_identical": bool(identical),
     }
 
 
@@ -782,6 +856,10 @@ def run_config(name: str, *, batch: int | None = None,
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         recovery = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        ckpt_async = _ckpt_async_metrics({"params": params, "opt": opt_state})
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        ckpt_async = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         supervisor = _supervisor_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         supervisor = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
@@ -808,6 +886,7 @@ def run_config(name: str, *, batch: int | None = None,
         "n_chips": n_chips,
         "device": str(dev.device_kind),
         "recovery": recovery,
+        "ckpt_async": ckpt_async,
         "supervisor": supervisor,
         "elastic": elastic,
         "serving": serving,
@@ -970,10 +1049,9 @@ def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
             raise subprocess.CalledProcessError(proc.returncode, proc.args)
         return json.loads(proc.stdout.strip().splitlines()[-1])
 
-    try:  # jax >= 0.6 exports it at top level
-        from jax import shard_map
-    except ImportError:  # jax 0.4.x
-        from jax.experimental.shard_map import shard_map
+    # the ONE spelling site for the shard_map import + rep-check kwarg
+    # drift across jax versions lives in utils.compat
+    from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu.optimizers import FusedAdam, FusedLAMB
@@ -1027,11 +1105,11 @@ def tp_dryrun(tp: int, model_name: str = "gpt-1.3b") -> dict:
 
     with mesh:
         init_sharded = shard_map(init_fn, mesh=mesh, in_specs=(P(),),
-                                 out_specs=P(), check_vma=False)
+                                 out_specs=P(), **NO_REP_CHECK)
         params_s, opt_s = jax.eval_shape(init_sharded, ids_s)
         step = jax.jit(shard_map(
             train_step, mesh=mesh, in_specs=(P(), P(), P()),
-            out_specs=(P(), P(), P()), check_vma=False),
+            out_specs=(P(), P(), P()), **NO_REP_CHECK),
             donate_argnums=(0, 1))
         compiled = step.lower(params_s, opt_s, ids_s).compile()
         mem = compiled.memory_analysis()

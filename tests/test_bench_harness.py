@@ -218,6 +218,32 @@ def test_recovery_metrics_block():
     assert r3["bytes"] <= 256
 
 
+def test_ckpt_async_metrics_block():
+    """The async-checkpoint block (ISSUE 8): step-loop blocking ms per
+    save for sync vs async, snapshot ms, background write ms, bytes —
+    and the byte-identical on-disk guarantee.  The ≥5x blocking
+    reduction is measured at the default (64 MB) size; at this toy size
+    only sanity is asserted."""
+    import jax.numpy as jnp
+
+    tree = {"a": jnp.ones((128, 128), jnp.float32),
+            "b": jnp.ones((64,), jnp.bfloat16)}
+    r = bench._ckpt_async_metrics(tree, n_saves=2)
+    assert r["ok"] is True
+    assert r["bytes"] == 128 * 128 * 4 + 64 * 2
+    assert r["sampled"] is False
+    assert r["n_saves"] == 2
+    for k in ("blocking_ms_per_save_sync", "blocking_ms_per_save_async",
+              "snapshot_ms", "write_ms_background",
+              "blocking_reduction_x"):
+        assert r[k] > 0.0, k
+    # async MUST be a scheduling change only: same bytes, same files
+    assert r["bytes_identical"] is True
+    # budget sampling rides the same helper as the recovery block
+    r2 = bench._ckpt_async_metrics(tree, byte_budget=64, n_saves=1)
+    assert r2["sampled"] is True and r2["bytes"] <= 64
+
+
 def test_supervisor_metrics_block():
     """The robustness-tax block (ISSUE 2 satellite): watchdog arm/disarm
     per-step cost, heartbeat write latency, and the 2-failure transient
@@ -324,6 +350,8 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     assert result["config"]["loss_end"] < result["config"]["loss0"]
     # the diagnostic blocks ride every captured config
     assert result["recovery"]["ok"] is True
+    assert result["ckpt_async"]["ok"] is True
+    assert result["ckpt_async"]["bytes_identical"] is True
     assert result["supervisor"]["ok"] is True
     assert result["elastic"]["ok"] is True
     assert result["serving"]["ok"] is True

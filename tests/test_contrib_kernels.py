@@ -9,7 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 
 
 # ---------------------------------------------------------------------------
@@ -140,7 +140,7 @@ def test_group_batch_norm_subgroup_stats():
 
     with mesh:
         y = jax.jit(shard_map(fn, mesh=mesh, in_specs=P("dp"),
-                              out_specs=P("dp"), check_vma=False))(
+                              out_specs=P("dp"), **NO_REP_CHECK))(
             jnp.asarray(x))
 
     y = np.asarray(y)

@@ -10,7 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 
 from apex_tpu.contrib.halo import (
     HaloExchanger1d,
@@ -35,7 +35,7 @@ def test_halo_exchange_attaches_neighbor_rows(mesh4):
     with mesh4:
         out = jax.jit(shard_map(fn, mesh=mesh4, in_specs=P(None, "spatial"),
                                 out_specs=P(None, "spatial"),
-                                check_vma=False))(x)
+                                **NO_REP_CHECK))(x)
     out = np.asarray(out)  # [1, 4 ranks * 4 rows, 1, 3]
     x_np = np.asarray(x)
     # rank 1 holds global rows 2:4; with halo it sees rows 1:5
@@ -61,7 +61,7 @@ def test_spatial_conv_matches_unsplit(mesh4):
     with mesh4:
         got = jax.jit(shard_map(fn, mesh=mesh4, in_specs=P(None, "spatial"),
                                 out_specs=P(None, "spatial"),
-                                check_vma=False))(x)
+                                **NO_REP_CHECK))(x)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
@@ -75,7 +75,7 @@ def test_halo_exchanger_object_form(mesh4):
     with mesh4:
         out = jax.jit(shard_map(fn, mesh=mesh4, in_specs=P(None, "spatial"),
                                 out_specs=P(None, "spatial"),
-                                check_vma=False))(x)
+                                **NO_REP_CHECK))(x)
     assert out.shape == (1, 8 + 2 * 2 * 4, 2, 2)
 
 
@@ -97,7 +97,7 @@ def test_spatial_bottleneck_matches_dense(mesh4):
     with mesh4:
         got = jax.jit(shard_map(fn, mesh=mesh4, in_specs=P(None, "spatial"),
                                 out_specs=P(None, "spatial"),
-                                check_vma=False))(x)
+                                **NO_REP_CHECK))(x)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
 
 

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
@@ -68,7 +68,7 @@ def _compiled_hlo(mesh, sequence_parallel):
     }
     with mesh:
         fn = jax.jit(shard_map(step, mesh=mesh, in_specs=(P(), P()),
-                               out_specs=(P(), P()), check_vma=False))
+                               out_specs=(P(), P()), **NO_REP_CHECK))
         return fn.lower(params, x_local).compile().as_text()
 
 
@@ -143,7 +143,7 @@ def test_1f1b_collective_plan_is_exact(devices):
         fn = jax.jit(shard_map(
             run, mesh=mesh,
             in_specs=({"w": P("pp"), "b": P("pp")}, P()),
-            out_specs=(P(), {"w": P("pp"), "b": P("pp")}), check_vma=False))
+            out_specs=(P(), {"w": P("pp"), "b": P("pp")}), **NO_REP_CHECK))
         hlo = fn.lower(stacked, batches).compile().as_text()
     finally:
         parallel_state.destroy_model_parallel()
@@ -185,7 +185,7 @@ def test_cp_ring_collective_plan_is_exact(devices):
     with mesh:
         f = jax.jit(shard_map(
             fn, mesh=mesh, in_specs=(P(None, None, "cp"),) * 3,
-            out_specs=(P(None, None, "cp"),) * 3, check_vma=False))
+            out_specs=(P(None, None, "cp"),) * 3, **NO_REP_CHECK))
         hlo = f.lower(q, q, q).compile().as_text()
 
     cp = _count(hlo, "collective-permute")
@@ -227,7 +227,7 @@ def test_ep_collective_plan_is_exact(devices):
 
     with mesh:
         f = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P("ep"), P()),
-                              out_specs=(P(), P("ep")), check_vma=False))
+                              out_specs=(P(), P("ep")), **NO_REP_CHECK))
         hlo = f.lower(x, local_params).compile().as_text()
 
     a2a = _count(hlo, "all-to-all")
@@ -257,7 +257,7 @@ def test_zero2_collective_plan_is_exact(devices):
 
     with mesh:
         f = jax.jit(shard_map(fn, mesh=mesh, in_specs=(P(), P()),
-                              out_specs=P(), check_vma=False))
+                              out_specs=P(), **NO_REP_CHECK))
         hlo = f.lower(params, params).compile().as_text()
 
     rs = _count(hlo, "reduce-scatter")
@@ -330,7 +330,7 @@ def test_interleaved_vpp_collective_plan_is_exact(devices):
             run, mesh=mesh,
             in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
             out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
-            check_vma=False))
+            **NO_REP_CHECK))
         hlo = fn.lower(stacked, batches).compile().as_text()
     finally:
         parallel_state.destroy_model_parallel()

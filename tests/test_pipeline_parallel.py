@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
@@ -75,7 +75,11 @@ def _sequential_reference(stacked, batches):
     return jax.value_and_grad(loss)(stacked)
 
 
-@pytest.mark.parametrize("n_micro", [4, 7])
+# one n_micro per schedule family stays in tier-1; the other params are
+# the same claim at another microbatch count and ride the slow tier
+# (each is a multi-second XLA-CPU pipeline compile)
+@pytest.mark.parametrize(
+    "n_micro", [pytest.param(4, marks=pytest.mark.slow), 7])
 def test_pipeline_matches_sequential(pp4_mesh, rng, n_micro):
     stacked = _make_stage_params(rng, 4)
     batches = {
@@ -95,7 +99,7 @@ def test_pipeline_matches_sequential(pp4_mesh, rng, n_micro):
         run, mesh=pp4_mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P()),
         out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
-        check_vma=False,
+        **NO_REP_CHECK,
     )(stacked, batches)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_grads["w"]),
@@ -104,7 +108,8 @@ def test_pipeline_matches_sequential(pp4_mesh, rng, n_micro):
                                rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("n_micro", [4, 7])
+@pytest.mark.parametrize(
+    "n_micro", [pytest.param(4, marks=pytest.mark.slow), 7])
 def test_1f1b_matches_sequential(pp4_mesh, rng, n_micro):
     stacked = _make_stage_params(rng, 4)
     batches = {
@@ -122,7 +127,7 @@ def test_1f1b_matches_sequential(pp4_mesh, rng, n_micro):
         run, mesh=pp4_mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P()),
         out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
-        check_vma=False,
+        **NO_REP_CHECK,
     ))(stacked, batches)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(grads["w"]), np.asarray(ref_grads["w"]),
@@ -151,7 +156,7 @@ def test_1f1b_memory_flat_in_num_microbatches(pp4_mesh, rng):
             run, mesh=pp4_mesh,
             in_specs=({"w": P("pp"), "b": P("pp")}, P()),
             out_specs=(P(), {"w": P("pp"), "b": P("pp")}),
-            check_vma=False))
+            **NO_REP_CHECK))
         mem = fn.lower(stacked, batches).compile().memory_analysis()
         assert mem is not None, "memory analysis unavailable on this backend"
         return mem.temp_size_in_bytes
@@ -206,7 +211,8 @@ def test_get_forward_backward_func():
             is forward_backward_pipelining_1f1b_interleaved)
 
 
-@pytest.mark.parametrize("n_micro", [4, 6])
+@pytest.mark.parametrize(
+    "n_micro", [pytest.param(4, marks=pytest.mark.slow), 6])
 def test_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
     """vpp=2 over pp=4: 8 global stages; parity vs sequential 8-layer run."""
     vpp, pp = 2, 4
@@ -236,7 +242,7 @@ def test_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
         run, mesh=pp4_mesh,
         in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
         out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
-        check_vma=False,
+        **NO_REP_CHECK,
     )(per_rank, batches)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
@@ -245,7 +251,9 @@ def test_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
         np.asarray(ref_grads["w"]), rtol=1e-4, atol=1e-6)
 
 
-@pytest.mark.parametrize("n_micro", [4, 6, 7])
+@pytest.mark.parametrize(
+    "n_micro", [pytest.param(4, marks=pytest.mark.slow),
+                pytest.param(6, marks=pytest.mark.slow), 7])
 def test_1f1b_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
     """Memory-bounded interleaved schedule: parity vs sequential AND vs the
     autodiff interleaved schedule (vpp=2 over pp=4, incl. a partial last
@@ -273,7 +281,7 @@ def test_1f1b_interleaved_matches_sequential(pp4_mesh, rng, n_micro):
         run, mesh=pp4_mesh,
         in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
         out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
-        check_vma=False,
+        **NO_REP_CHECK,
     )(per_rank, batches)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
@@ -311,7 +319,7 @@ def test_1f1b_interleaved_memory_flat_in_num_microbatches(pp4_mesh, rng):
             run, mesh=pp4_mesh,
             in_specs=({"w": P(None, "pp"), "b": P(None, "pp")}, P()),
             out_specs=(P(), {"w": P(None, "pp"), "b": P(None, "pp")}),
-            check_vma=False))
+            **NO_REP_CHECK))
         mem = fn.lower(per_rank, batches).compile().memory_analysis()
         assert mem is not None, "memory analysis unavailable on this backend"
         return mem.temp_size_in_bytes
@@ -344,6 +352,6 @@ def test_pipeline_forward_only(pp4_mesh, rng):
         run, mesh=pp4_mesh,
         in_specs=({"w": P("pp"), "b": P("pp")}, P()),
         out_specs=P(),
-        check_vma=False,
+        **NO_REP_CHECK,
     )(stacked, batches)
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)

@@ -877,3 +877,261 @@ def test_injected_nan_step_skips_but_run_recovers(tmp_path):
                for l in jax.tree.leaves(params))
     # still converging after the fault (same held-out batch, fewer nats)
     assert float(loss_fn(params, eval_batch)) < loss_before
+
+
+# --------------------------------------------------------------------------
+# asynchronous checkpoint pipeline (ISSUE 8): snapshot on the hot path,
+# background writer, crash consistency, vetoable commit
+# --------------------------------------------------------------------------
+
+
+def _dir_bytes(path):
+    return {name: open(os.path.join(path, name), "rb").read()
+            for name in sorted(os.listdir(path))}
+
+
+class TestAsyncCheckpoint:
+    def test_async_v1_bytes_and_restore_identical_to_sync(self, tmp_path):
+        state = _state_tree(3)
+        sync_path = rz.save_checkpoint(str(tmp_path / "sync"), 7, state)
+        ac = rz.AsyncCheckpointer(
+            rz.CheckpointManager(str(tmp_path / "async")))
+        fut = ac.save(7, state)
+        assert fut.result() is not None and fut.done()
+        assert fut.snapshot_s is not None and fut.write_s is not None
+        # the on-disk format is BYTE-identical: async is scheduling, not
+        # a format change
+        assert _dir_bytes(sync_path) == _dir_bytes(fut.path)
+        a, sa = rz.restore_checkpoint(str(tmp_path / "sync"),
+                                      like=_state_tree())
+        b, sb = rz.restore_checkpoint(str(tmp_path / "async"),
+                                      like=_state_tree())
+        assert sa == sb == 7
+        _tree_equal(a, b)
+
+    def test_async_v2_sharded_bytes_and_restore_identical(self, tmp_path):
+        state = _state_tree(5)
+        sync_path = rz.save_sharded_checkpoint(str(tmp_path / "sync"), 9,
+                                               state)
+        ac = rz.AsyncCheckpointer(
+            rz.ShardedCheckpointManager(str(tmp_path / "async")))
+        fut = ac.save(9, state)
+        fut.result()
+        assert _dir_bytes(sync_path) == _dir_bytes(fut.path)
+        a, sa = rz.restore_sharded_checkpoint(str(tmp_path / "sync"),
+                                              like=_state_tree())
+        b, sb = rz.restore_sharded_checkpoint(str(tmp_path / "async"),
+                                              like=_state_tree())
+        assert sa == sb == 9
+        _tree_equal(a, b)
+
+    def test_snapshot_is_donation_safe(self, tmp_path):
+        """Mutating the live state after save() returns must not change
+        what the background writer serializes — the snapshot owns its
+        bytes (on CPU, device_get can alias the live buffer)."""
+        import threading
+
+        live = {"w": np.arange(16.0, dtype=np.float32)}
+        want = live["w"].copy()
+        gate = threading.Event()
+        ac = rz.AsyncCheckpointer(
+            rz.CheckpointManager(str(tmp_path)),
+            progress_hook=lambda p: gate.wait(10.0))
+        fut = ac.save(0, live)
+        live["w"] *= -1.0  # the "next step" clobbers the live buffer
+        gate.set()
+        fut.result()
+        restored, _ = rz.restore_checkpoint(
+            str(tmp_path), like={"w": np.zeros(16, np.float32)})
+        np.testing.assert_array_equal(np.asarray(restored["w"]), want)
+
+    def test_backpressure_blocks_next_save_until_write_drains(
+            self, tmp_path):
+        import threading
+
+        from apex_tpu.resilience import async_checkpoint as ackpt
+
+        tree = {"w": jnp.arange(8.0)}
+        gate = threading.Event()
+        gates = {0: gate}  # only step 0's write is held open
+
+        def hook(progress):
+            g = gates.get(progress["step"])
+            if g is not None:
+                assert g.wait(10.0)
+
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(str(tmp_path)),
+                                  progress_hook=hook)
+        before = ackpt._BACKPRESSURE.value()
+        fut0 = ac.save(0, tree)
+        second = {}
+
+        def submit():
+            second["fut"] = ac.save(1, tree)
+
+        t = threading.Thread(target=submit)
+        t.start()
+        t.join(timeout=0.2)
+        # save(1) is blocked joining the in-flight write — the step
+        # loop's thread, not the write, is what backpressure stalls
+        assert t.is_alive() and not fut0.done()
+        gate.set()
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+        assert fut0.error is None
+        second["fut"].result()
+        assert ackpt._BACKPRESSURE.value() == before + 1
+        assert rz.latest_valid_step(str(tmp_path)) == 1
+
+    def test_crash_mid_write_never_commits_and_falls_back(self, tmp_path):
+        """THE crash-consistency run (v1): kill the writer mid-write;
+        no partially written dir is ever selectable, restore falls back
+        to the previous step bit-identically, the litter is swept."""
+        root = str(tmp_path)
+        state0, state1 = _state_tree(0), _state_tree(1)
+        mgr = rz.CheckpointManager(root, keep=3)
+        mgr.save(0, state0)
+
+        crash = rz.CrashCheckpointWriter(after_records=2)
+        ac = rz.AsyncCheckpointer(mgr, progress_hook=crash)
+        fut = ac.save(1, state1)
+        fut.join()
+        assert isinstance(fut.error, rz.SimulatedWriterCrash)
+        assert crash.fired
+        # hard-kill semantics: the partial temp dir is LEFT on disk...
+        litter = [n for n in os.listdir(root) if n.startswith(_TMP_PREFIX)]
+        assert litter
+        # ...but can never be selected: not a step dir, never committed
+        assert rz.latest_valid_step(root) is None or \
+            rz.latest_valid_step(root) == 0
+        assert rz.latest_valid_step(root) == 0
+        restored, step = mgr.restore(like=_state_tree())
+        assert step == 0
+        _tree_equal(restored, state0)
+        # the next save sweeps the orphaned litter and commits normally
+        ac2 = rz.AsyncCheckpointer(mgr)
+        ac2.save(2, state1).result()
+        assert not [n for n in os.listdir(root)
+                    if n.startswith(_TMP_PREFIX)]
+        assert rz.latest_valid_step(root) == 2
+
+    def test_crash_mid_write_sharded_falls_back(self, tmp_path):
+        """Crash consistency on the v2 (sharded) format, and async-vs-
+        sync restores stay bit-identical across the fallback."""
+        root_a, root_s = str(tmp_path / "a"), str(tmp_path / "s")
+        state0 = _state_tree(0)
+        mgr = rz.ShardedCheckpointManager(root_a, keep=3)
+        ac = rz.AsyncCheckpointer(mgr)
+        ac.save(0, state0).result()
+        rz.save_sharded_checkpoint(root_s, 0, state0)
+
+        crash = rz.CrashCheckpointWriter(after_records=3)
+        ac_crash = rz.AsyncCheckpointer(mgr, progress_hook=crash)
+        fut = ac_crash.save(1, _state_tree(1))
+        fut.join()
+        assert isinstance(fut.error, rz.SimulatedWriterCrash)
+        assert rz.latest_valid_step(root_a) == 0
+        a, sa = mgr.restore(like=_state_tree())
+        b, sb = rz.restore_sharded_checkpoint(root_s, like=_state_tree())
+        assert sa == sb == 0
+        _tree_equal(a, b)
+
+    def test_veto_aborts_commit_without_a_step_dir(self, tmp_path):
+        import threading
+
+        tree = {"w": jnp.arange(4.0)}
+        gate = threading.Event()
+        ac = rz.AsyncCheckpointer(
+            rz.CheckpointManager(str(tmp_path)),
+            progress_hook=lambda p: gate.wait(10.0))
+        records = []
+        handler = logging.Handler()
+        handler.emit = lambda r: records.append(r.getMessage())
+        ev_logger = logging.getLogger("apex_tpu.events")
+        ev_logger.addHandler(handler)
+        ev_logger.setLevel(logging.INFO)  # order-independent capture
+        try:
+            fut = ac.save(3, tree)
+            assert ac.veto("consistency failed") is True
+            gate.set()
+            fut.join()
+        finally:
+            ev_logger.removeHandler(handler)
+        assert isinstance(fut.error, rz.SaveVetoed)
+        assert fut.path is None
+        assert rz.latest_valid_step(str(tmp_path)) is None
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if n.startswith(_TMP_PREFIX)]  # veto cleans its temp
+        vetoed = [json.loads(m) for m in records
+                  if '"checkpoint_commit_vetoed"' in m]
+        assert vetoed and vetoed[0]["step"] == 3
+        # a veto is not a failure: the next save proceeds cleanly
+        ac.save(4, tree).result()
+        assert rz.latest_valid_step(str(tmp_path)) == 4
+        assert ac.veto("nothing in flight") is False
+
+    def test_unharvested_failure_surfaces_on_next_save(self, tmp_path):
+        tree = {"w": jnp.arange(4.0)}
+        mgr = rz.CheckpointManager(str(tmp_path))
+        crash = rz.CrashCheckpointWriter(after_records=1)
+        ac = rz.AsyncCheckpointer(mgr, progress_hook=crash)
+        fut = ac.save(0, tree)
+        fut.join()
+        # the failure was never polled/waited: the next save raises it
+        # exactly where a synchronous manager.save would have
+        with pytest.raises(rz.SimulatedWriterCrash):
+            ac.save(1, tree)
+        # ...once surfaced, the pipeline is clean again (crash is one-shot)
+        ac.save(2, tree).result()
+        assert rz.latest_valid_step(str(tmp_path)) == 2
+
+    def test_sweep_and_rotation_respect_live_writer(self, tmp_path):
+        """A concurrent save into the same root (the emergency path)
+        must neither sweep the background writer's temp dir nor rotate
+        away the step it is producing."""
+        import threading
+
+        root = str(tmp_path)
+        tree = {"w": jnp.arange(64.0)}
+        gate = threading.Event()
+        ac = rz.AsyncCheckpointer(
+            rz.CheckpointManager(root, keep=3),
+            progress_hook=lambda p: gate.wait(10.0))
+        fut = ac.save(5, tree)
+        # while the writer is mid-flight, a sync save lands in the root
+        rz.save_checkpoint(root, 6, tree, keep=1)
+        litter = [n for n in os.listdir(root) if n.startswith(_TMP_PREFIX)]
+        assert litter, "sync save swept the live writer's temp dir"
+        gate.set()
+        fut.result()
+        steps = sorted(rz.CheckpointManager(root).all_steps())
+        assert steps == [5, 6]
+        assert not [n for n in os.listdir(root)
+                    if n.startswith(_TMP_PREFIX)]
+
+    def test_wait_and_poll_lifecycle(self, tmp_path):
+        tree = {"w": jnp.arange(4.0)}
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(str(tmp_path)))
+        assert ac.poll() is None and ac.wait() is None
+        fut = ac.save(0, tree)
+        got = ac.wait()
+        assert got is fut and got.error is None
+        assert ac.poll() is None  # already harvested
+        fut2 = ac.save(1, tree)
+        fut2.join()
+        assert ac.poll() is fut2  # done -> harvested without blocking
+
+    def test_manager_without_two_phase_surface_rejected(self):
+        with pytest.raises(TypeError):
+            rz.AsyncCheckpointer(object())
+
+    def test_writer_crash_hook_validates_and_targets_steps(self):
+        with pytest.raises(ValueError):
+            rz.CrashCheckpointWriter(after_records=0)
+        hook = rz.CrashCheckpointWriter(after_records=1, steps=(7,))
+        hook({"step": 3, "record": 0, "bytes": 8})  # wrong step: no fire
+        assert not hook.fired
+        with pytest.raises(rz.SimulatedWriterCrash):
+            hook({"step": 7, "record": 0, "bytes": 8})
+        assert hook.fired
+        hook({"step": 7, "record": 1, "bytes": 16})  # one-shot: no re-fire

@@ -984,3 +984,247 @@ def test_acceptance_faulted_run_degrades_then_resumes_bit_identically(
         assert run_losses[i] == ref_losses[i], (
             f"loss diverged at step {i}: {run_losses[i]} != {ref_losses[i]}")
     _tree_equal(final, ref_final)
+
+
+# --------------------------------------------------------------------------
+# asynchronous checkpoint pipeline under the supervisor (ISSUE 8):
+# snapshot-only blocking, backpressure, failed-write ladder, emergency/
+# shutdown joins, consistency veto — and THE acceptance run: an async-
+# interrupted run resumes bit-identically through the existing harness
+# --------------------------------------------------------------------------
+
+
+def _accum_step(state, batch, step):
+    return {"w": state["w"] + batch, "n": state["n"] + 1}
+
+
+def _accum_state():
+    return {"w": jnp.zeros((4, 4), jnp.float32), "n": jnp.int32(0)}
+
+
+def _accum_batches(n):
+    return [jnp.full((4, 4), float(i + 1), jnp.float32) for i in range(n)]
+
+
+def _step_dirs(root):
+    return sorted(d for d in os.listdir(root) if d.startswith("step_"))
+
+
+def _dir_bytes(path):
+    return {name: open(os.path.join(path, name), "rb").read()
+            for name in sorted(os.listdir(path))}
+
+
+class TestAsyncSupervisor:
+    def test_async_run_matches_sync_run_byte_for_byte(self, tmp_path):
+        """async_save=True must change WHEN the write happens, not one
+        byte of what lands on disk — every periodic step dir compares
+        equal to the sync run's, and the final states match."""
+        roots = {"sync": str(tmp_path / "sync"),
+                 "async": str(tmp_path / "async")}
+        finals = {}
+        for mode, root in roots.items():
+            sup = rz.TrainingSupervisor(
+                rz.CheckpointManager(root, keep=10),
+                _fast_config(checkpoint_every=2,
+                             async_save=(mode == "async")))
+            finals[mode], last = sup.run(
+                _accum_step, _accum_state(), _accum_batches(6), num_steps=6)
+            assert last == 5
+        _tree_equal(finals["sync"], finals["async"])
+        assert _step_dirs(roots["sync"]) == _step_dirs(roots["async"])
+        for d in _step_dirs(roots["sync"]):
+            assert _dir_bytes(os.path.join(roots["sync"], d)) == \
+                _dir_bytes(os.path.join(roots["async"], d)), d
+
+    def test_heartbeat_pointer_advances_only_on_committed_dirs(
+            self, tmp_path):
+        hb = str(tmp_path / "hb.json")
+        root = str(tmp_path / "ckpts")
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(root, keep=10),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         heartbeat_path=hb))
+        sup.run(_accum_step, _accum_state(), _accum_batches(4), num_steps=4)
+        beat = rz.read_heartbeat(hb)
+        # the final drain published the LAST committed step's path
+        assert beat["ckpt_path"] is not None
+        assert beat["ckpt_path"].endswith("step_0000000003")
+        rz.validate_checkpoint(beat["ckpt_path"])
+
+    def test_failed_background_write_joins_failure_ladder(
+            self, tmp_path, events):
+        """A background write that exhausts its transient retries
+        surfaces at the next step boundary as one supervisor failure —
+        the same accounting a failed synchronous save gets."""
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(str(tmp_path)),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         max_consecutive_failures=50))
+        # every write attempt dies on a transient error (hook runs per
+        # record inside the write machinery, under config.retry)
+        def bad_io(progress):
+            raise OSError("injected transient write failure")
+
+        sup._async.progress_hook = bad_io
+        state, last = sup.run(_accum_step, _accum_state(),
+                              _accum_batches(3), num_steps=3)
+        assert last == 2  # the run survived: writes failed, steps didn't
+        fails = events("supervisor_failure")
+        assert fails and all(f["failure"] == "RetryExhausted"
+                             for f in fails)
+        assert not _step_dirs(str(tmp_path))
+
+    def test_escalation_joins_inflight_write_then_checkpoints(
+            self, tmp_path):
+        """Emergency checkpointing must join the in-flight background
+        write first (single-writer root) — both the periodic dir and the
+        emergency dir end up committed and valid."""
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(str(tmp_path), keep=10),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         max_consecutive_failures=1))
+        sup._async.progress_hook = lambda p: time.sleep(0.2)  # slow writer
+        fut = sup._async.save(0, {"w": jnp.arange(4.0)})
+        assert not fut.done()
+        with pytest.raises(rz.TrainingAborted) as ei:
+            sup.record_failure(1, {"w": jnp.ones(4)},
+                               rz.StepDeadlineExceeded(1, 1.0, 2.0))
+        # the join happened before the emergency save: the periodic
+        # write committed (not swept/aborted), the emergency dir too
+        assert fut.done() and fut.error is None
+        assert _step_dirs(str(tmp_path)) == ["step_0000000000",
+                                             "step_0000000001"]
+        rz.validate_checkpoint(ei.value.checkpoint_path)
+
+    def test_consistency_failure_vetoes_inflight_commit(
+            self, tmp_path, events):
+        """ISSUE 8: a failed consistency pass must ALSO veto the write
+        already in the air — an untrusted lineage never becomes
+        latest_valid_step, not even through a commit scheduled before
+        the pass ran."""
+        class FlakyConsistency:
+            calls = 0
+
+            def check(self, state, step):
+                type(self).calls += 1
+                if type(self).calls == 1:
+                    raise rz.ReplicaDesyncError(step, [])
+                return state
+
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(str(tmp_path), keep=10),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         consistency_check_interval=2,
+                         max_consecutive_failures=50),
+            consistency=FlakyConsistency())
+        sup._async.progress_hook = lambda p: time.sleep(0.25)  # in flight
+        state, last = sup.run(_accum_step, _accum_state(),
+                              _accum_batches(6), num_steps=6)
+        assert last == 5
+        dirs = _step_dirs(str(tmp_path))
+        # step 0's write was in flight when the step-1 pass failed: the
+        # veto killed it.  Steps 1 and 2 never scheduled (untrusted);
+        # the step-3 pass re-proved the state clean, so 3.. committed.
+        assert "step_0000000000" not in dirs
+        assert "step_0000000001" not in dirs
+        assert "step_0000000002" not in dirs
+        assert {"step_0000000003", "step_0000000004",
+                "step_0000000005"} <= set(dirs)
+        assert events("checkpoint_commit_vetoed")
+        assert rz.latest_valid_step(str(tmp_path)) == 5
+
+    def test_acceptance_async_interrupted_run_resumes_bit_identically(
+            self, tmp_path):
+        """THE ISSUE-8 acceptance run: preempt an async_save run mid-
+        flight, restart from latest_valid_step through the normal
+        restore path, finish — the final state is bit-identical to an
+        uninterrupted SYNC run, and every surviving step dir is byte-
+        identical to the sync run's."""
+        n = 8
+        sync_root = str(tmp_path / "sync")
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(sync_root, keep=20),
+            _fast_config(checkpoint_every=1))
+        ref_final, _ = sup.run(_accum_step, _accum_state(),
+                               _accum_batches(n), num_steps=n)
+
+        async_root = str(tmp_path / "async")
+        mgr = rz.CheckpointManager(async_root, keep=20)
+        injector = rz.FaultInjector(rz.FaultPlan(preempt_steps=(5,)))
+
+        def preempting_step(state, batch, step):
+            injector.check_preemption(step)
+            return _accum_step(state, batch, step)
+
+        sup1 = rz.TrainingSupervisor(
+            mgr, _fast_config(checkpoint_every=1, async_save=True))
+        with pytest.raises(rz.SimulatedPreemption):
+            sup1.run(preempting_step, _accum_state(), _accum_batches(n),
+                     num_steps=n)
+        # restart: newest VALID checkpoint (an in-flight write at the
+        # kill either committed whole or is invisible), resume async
+        resume_state, last = mgr.restore(like=_accum_state())
+        assert last == rz.latest_valid_step(async_root) == 4
+        sup2 = rz.TrainingSupervisor(
+            mgr, _fast_config(checkpoint_every=1, async_save=True))
+        final, done = sup2.run(_accum_step, resume_state,
+                               _accum_batches(n)[last + 1:],
+                               num_steps=n, start_step=last + 1)
+        assert done == n - 1
+        _tree_equal(final, ref_final)
+        for d in _step_dirs(async_root):
+            assert _dir_bytes(os.path.join(async_root, d)) == \
+                _dir_bytes(os.path.join(sync_root, d)), d
+
+    def test_resume_pointer_advances_under_sustained_backpressure(
+            self, tmp_path):
+        """Write duration persistently longer than the save interval:
+        every success's future is consumed by the next save's
+        backpressure join (poll never sees it), and the heartbeat's
+        resume pointer must STILL advance mid-run — the lossless
+        last_committed record, not future harvesting, feeds the beat."""
+        hb = str(tmp_path / "hb.json")
+        root = str(tmp_path / "ckpts")
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(root, keep=20),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         heartbeat_path=hb))
+        sup._async.progress_hook = lambda p: time.sleep(0.1)  # slow write
+        seen = {}
+
+        def step_fn(state, batch, step):
+            if step == 3:  # mid-run, while write(2) is still in the air
+                seen["beat"] = rz.read_heartbeat(hb)
+            return _accum_step(state, batch, step)
+
+        sup.run(step_fn, _accum_state(), _accum_batches(4), num_steps=4)
+        assert seen["beat"]["ckpt_path"] is not None, (
+            "resume pointer never advanced while writes overlapped saves")
+        rz.validate_checkpoint(seen["beat"]["ckpt_path"])
+        assert len(_step_dirs(root)) == 4  # every periodic save committed
+        final = rz.read_heartbeat(hb)
+        assert final["ckpt_path"].endswith("step_0000000003")
+
+    def test_shutdown_drain_never_regresses_emergency_pointer(
+            self, tmp_path):
+        """After escalate() publishes the emergency checkpoint, the
+        shutdown drain must not overwrite the heartbeat's resume
+        pointer with an OLDER async commit."""
+        hb = str(tmp_path / "hb.json")
+        sup = rz.TrainingSupervisor(
+            rz.CheckpointManager(str(tmp_path / "c"), keep=20),
+            _fast_config(checkpoint_every=1, async_save=True,
+                         max_consecutive_failures=1,
+                         heartbeat_path=hb))
+        # an async commit for step 0, then escalation at step 6
+        sup._async.save(0, {"w": jnp.arange(4.0)}).result()
+        with pytest.raises(rz.TrainingAborted) as ei:
+            sup.record_failure(6, {"w": jnp.ones(4)},
+                               rz.StepDeadlineExceeded(6, 1.0, 2.0))
+        assert ei.value.checkpoint_path.endswith("step_0000000006")
+        # the drain run()'s finally performs: must be a no-op here
+        sup._async.wait(timeout=5.0)
+        sup._beat_if_newer(6)
+        beat = rz.read_heartbeat(hb)
+        assert beat["ckpt_path"] == ei.value.checkpoint_path

@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.transformer import parallel_state
@@ -56,7 +56,11 @@ def dp2tp4_mesh(devices):
 
 # SP=True is the stronger variant (exercises every SP mapping on top of
 # TP); the SP=False collective plan is pinned by test_tensor_parallel and
-# test_hlo_comm_plan, so one full-model run suffices for suite wall time
+# test_hlo_comm_plan, so one full-model run suffices for suite wall time.
+# slow: grad-of-shard_map over the full model is a ~26 s XLA-CPU compile
+# — the tp/sp mappings stay covered in tier-1 by test_tensor_parallel +
+# test_hlo_comm_plan; this whole-model bitwise run rides the slow tier
+@pytest.mark.slow
 @pytest.mark.parametrize("sp", [True])
 def test_gpt_tp_matches_single_device(tp4_mesh, rng, sp):
     """Same full weights: tp=4 (±sequence parallel) loss/grads == world-1 run."""
@@ -85,7 +89,7 @@ def test_gpt_tp_matches_single_device(tp4_mesh, rng, sp):
 
     loss, gk, gln = shard_map(
         run, mesh=tp4_mesh, in_specs=(P(), P()),
-        out_specs=(P(), P(None), P(None)), check_vma=False)(full, ids)
+        out_specs=(P(), P(None), P(None)), **NO_REP_CHECK)(full, ids)
 
     np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
     rk = ref_grads["params"]["language_model"]["transformer"]["layer_0"][
@@ -95,6 +99,7 @@ def test_gpt_tp_matches_single_device(tp4_mesh, rng, sp):
     np.testing.assert_allclose(np.asarray(gln), np.asarray(rln), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # 8-step dp×tp trajectory: ~11 s compile on XLA-CPU
 def test_gpt_trains_on_dp_tp_mesh(dp2tp4_mesh, rng):
     """GPT minimal training parity: dp=2 × tp=4 from the same full weights must
     reproduce the single-device loss trajectory step for step, and the loss
@@ -139,14 +144,14 @@ def test_gpt_trains_on_dp_tp_mesh(dp2tp4_mesh, rng):
     with dp2tp4_mesh:
         params, opt_state = shard_map(
             init_fn, mesh=dp2tp4_mesh, in_specs=(P(),),
-            out_specs=P(), check_vma=False)(full)
+            out_specs=P(), **NO_REP_CHECK)(full)
         # params replicated over dp, sharded over tp (per-rank views).
         # jax.jit on top of shard_map is essential: a bare shard_map call
         # re-traces and re-compiles every invocation (~40s/step on CPU).
         step_m = jax.jit(shard_map(
             step, mesh=dp2tp4_mesh,
             in_specs=(P(), P(), P("dp")), out_specs=(P(), P(), P()),
-            check_vma=False))
+            **NO_REP_CHECK))
         losses = []
         for _ in range(8):
             params, opt_state, loss = step_m(params, opt_state, ids)
@@ -185,11 +190,17 @@ def test_gpt_rope_variant(rng):
     assert np.isfinite(np.asarray(loss)).all()
 
 
+# the plain remat flag stays in tier-1; each named policy is another
+# whole-model compile (~3 s) re-proving the same loss-parity claim and
+# rides the slow tier
 @pytest.mark.parametrize("kwargs", [
     dict(activations_checkpoint=True),
-    dict(activations_checkpoint_policy="dots"),
-    dict(activations_checkpoint_policy="dots_no_batch"),
-    dict(activations_checkpoint_policy="except_activations"),
+    pytest.param(dict(activations_checkpoint_policy="dots"),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(activations_checkpoint_policy="dots_no_batch"),
+                 marks=pytest.mark.slow),
+    pytest.param(dict(activations_checkpoint_policy="except_activations"),
+                 marks=pytest.mark.slow),
 ])
 def test_gpt_activation_checkpointing_same_loss(rng, kwargs):
     ids = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)

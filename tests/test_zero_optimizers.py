@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from apex_tpu.utils.compat import NO_REP_CHECK, shard_map
 from jax.sharding import PartitionSpec as P
 
 from apex_tpu.contrib.optimizers import (
@@ -50,7 +50,7 @@ def run_distributed(opt, params, base_grads, mesh, **step_kw):
     with mesh:
         return jax.jit(shard_map(
             fn, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
-            check_vma=False))(params, base_grads)
+            **NO_REP_CHECK))(params, base_grads)
 
 
 def run_reference(opt, params, base_grads):
@@ -154,7 +154,7 @@ def test_found_inf_skips_update(mesh8, rng):
     with mesh8:
         new_params, step = jax.jit(shard_map(
             fn, mesh=mesh8, in_specs=(P(), P()), out_specs=(P(), P()),
-            check_vma=False))(params, grads)
+            **NO_REP_CHECK))(params, grads)
     # capturable semantics: the WHOLE state reverts on overflow, step
     # included, matching FusedOptimizer so bias corrections stay in lockstep
     assert int(step) == 0
@@ -170,7 +170,7 @@ def test_state_is_sharded_over_dp(mesh8, rng):
     with mesh8:
         state = jax.jit(shard_map(
             opt.init, mesh=mesh8, in_specs=(P(),),
-            out_specs=opt.state_specs(), check_vma=False))(params)
+            out_specs=opt.state_specs(), **NO_REP_CHECK))(params)
 
     total = state.exp_avg.shape[0]
     n_elems = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))

@@ -32,13 +32,15 @@ SCAN = ("apex_tpu", "tools", "examples", "bench.py")
 # "relpath::qualname" of handlers audited and accepted as-is.  Every
 # entry must keep matching a real broad-and-silent handler — a stale
 # entry fails the lint too, so the list can only shrink or be
-# consciously re-justified.  Last audited with ISSUE 6 (apex_tpu/obs/
-# lands lint-clean: the emit_event sink fan-out, gauge set_function
-# evaluation, and the jax-profiler hooks all debug/warning-log their
-# swallowed failures — no entry needed; ISSUE 4's audit note: serving
-# has no broad handlers at all, and bench's serving/obs blocks use the
-# same logged `except Exception` pattern as the other diagnostic
-# blocks).
+# consciously re-justified.  Last audited with ISSUE 8 (the async
+# checkpoint pipeline lands lint-clean: the writer thread's broad
+# `except BaseException` both logs AND store-forwards the exception
+# onto its SaveFuture — the store-forwarding idiom _is_silent already
+# recognizes — and the write machinery's cleanup handlers re-raise; no
+# entry needed.  Earlier notes: ISSUE 6 obs/ sink fan-out and profiler
+# hooks debug/warning-log their swallowed failures; ISSUE 4 serving has
+# no broad handlers; bench's diagnostic blocks use the logged `except
+# Exception` pattern).
 ALLOWLIST = {
     # availability probes: False/None IS the complete answer
     "apex_tpu/feature_registry.py::on_tpu",

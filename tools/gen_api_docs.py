@@ -79,6 +79,7 @@ PAGES = {
     ]),
     "resilience": ("Training resilience", [
         "apex_tpu.resilience", "apex_tpu.resilience.checkpoint",
+        "apex_tpu.resilience.async_checkpoint",
         "apex_tpu.resilience.elastic",
         "apex_tpu.resilience.consistency",
         "apex_tpu.resilience.fault_injection",
@@ -336,6 +337,57 @@ CRC, and the newest-valid fallback walk skips the damaged step with a
 sizes must divide evenly by their partitioning axes (uneven/padded
 shards have no stable byte layout to reshard from).
 
+## Asynchronous checkpointing
+
+`SupervisorConfig(async_save=True)` (default **off** — the synchronous
+path stays the escape hatch and the bit-identical reference) takes the
+periodic save off the training hot path.  The save splits into two
+phases with an honest cost model:
+
+- **Snapshot** (the only thing the step loop blocks on): ONE batched
+  device→host copy into *owned* host buffers — donation-safe, so the
+  next step may overwrite the live state immediately.  Cost ≈ a memcpy
+  of the state (`apex_checkpoint_duration_seconds{op="snapshot"}`).
+- **Write** (a background thread): the *existing* serialize / per-leaf
+  CRC32 / manifest / atomic-rename / rotation machinery — v1
+  `CheckpointManager` and v2 `ShardedCheckpointManager` both — streamed
+  into a `tmp_*` dir with incremental fsync.  Cost ≈ serialize + CRC +
+  disk bandwidth (`{op="write"}`), paid off the step loop.  The bytes
+  on disk are **identical** to a synchronous save (both modes share one
+  writer function; tier-1 compares the files), so restore is
+  bit-identical too.
+
+Join rules (`AsyncCheckpointer`; all pinned by tier-1):
+
+- **At most one write in flight.**  Backpressure blocks the *next*
+  `save()` — which joins the previous write first, counted in
+  `apex_checkpoint_backpressure_total` — never the step itself.
+- **A failed write surfaces at the next step boundary** (the
+  supervisor polls the `SaveFuture` each step) and joins the same
+  retry/escalation ladder as a synchronous save failure; an
+  unharvested failure re-raises on the next `save()`.
+- **Emergency checkpoint and shutdown JOIN the in-flight write first**:
+  the escalation path never races the background writer for the
+  single-writer root, and a run never exits abandoning a nearly
+  committed checkpoint.
+- **A failed consistency pass vetoes the in-flight commit**
+  (`AsyncCheckpointer.veto`): the write aborts at its commit gate,
+  *before* the atomic rename (`SaveVetoed`, temp dir cleaned).  The
+  veto is honored up to the gate — a write already past it lands,
+  which is exactly what synchronous mode would have committed at the
+  previous boundary; untrusted-state protection for every NEW commit
+  comes from the supervisor's sticky trust flag in both modes.
+- **Crash-consistency is unchanged**: a writer killed mid-write leaves
+  only a `tmp_*` dir that `latest_valid_step` / the restore walk can
+  never select (`CrashCheckpointWriter` drives this in tier-1);
+  rotation counts only committed dirs and never touches the step an
+  in-flight writer is producing.
+
+`bench.py`'s `ckpt_async` block measures the split: at the 64 MB bench
+budget the step-loop blocking time per save drops from the full
+serialize+fsync wall time to the snapshot alone (≥5x reduction
+measured), with byte-identical files.
+
 ## Cross-replica consistency
 
 Data-parallel replicas are supposed to be bit-identical; at pod scale
@@ -529,7 +581,9 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_batches_skipped_total` | counter | `batch_skipped` events |
 | `apex_replica_desync_total` | counter | `replica_desync` events |
 | `apex_faults_injected_total{fault}` | counter | `fault_injected` events |
-| `apex_checkpoint_duration_seconds{op}` | histogram | save/validate/restore wall time |
+| `apex_checkpoint_duration_seconds{op}` | histogram | save/validate/restore wall time, plus the async split: `snapshot` (step-loop blocking) vs `write` (background) |
+| `apex_checkpoint_inflight` | gauge | `AsyncCheckpointer` (at most one write in flight per pipeline; concurrent pipelines sum) |
+| `apex_checkpoint_backpressure_total` | counter | async saves that joined a still-running previous write |
 | `apex_checkpoints_rejected_total` | counter | `checkpoint_rejected` events |
 | `apex_serving_ttft_seconds` | histogram | `serving_first_token` events |
 | `apex_serving_prefill_duration_seconds{bucket}` | histogram | `serving_prefill_chunk` events (label = bucket size; bounded by the engine's bucket table) |
@@ -765,6 +819,27 @@ step is reported mid-stall by the watchdog's monitor thread (structured
 can kill and requeue with evidence.  Every path above is driven
 deterministically in tier-1 by the fault injectors (`SlowStep`,
 `FlakyIterator`, `CorruptBatch`).
+
+Take the save off the hot path — once steps are fast, the periodic
+checkpoint's serialize+CRC+fsync wall time is the dominant stall left.
+`SupervisorConfig(async_save=True)` makes the step loop block only on a
+device→host **snapshot** (≈ a memcpy, donation-safe) while a background
+thread runs the existing write machinery — same bytes on disk, same
+restores, bit-identical ([full page](api/resilience.md)):
+
+```python
+sup = rz.TrainingSupervisor(mgr, rz.SupervisorConfig(
+    checkpoint_every=50,
+    async_save=True))      # snapshot on the step, write in the background
+```
+
+At most one write is in flight (the *next* save joins it first —
+backpressure never blocks the step); a failed write surfaces at the next
+step boundary into the same retry/escalation ladder; emergency
+checkpoints and shutdown join the in-flight write; a failed consistency
+pass vetoes an in-flight commit.  `async_save=False` (the default) is
+the synchronous escape hatch.  Standalone use:
+`rz.AsyncCheckpointer(mgr).save(step, state)` returns a `SaveFuture`.
 
 Resize the pod mid-training — a preempted job rarely gets the same slice
 back.  *Sharded* checkpoints (manifest v2) record one CRC'd shard per

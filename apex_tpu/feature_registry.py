@@ -69,7 +69,8 @@ register("resilience", "validated checkpointing + fault injection + guarded step
          False, "host I/O + jnp")
 register("supervisor", "step watchdog + heartbeat + transient retry + data guard + escalation",
          False, "host threads + I/O")
-register("serving", "slotted KV-cache decode + continuous batching + checkpoint serving",
+register("serving", "slotted KV-cache decode + continuous batching + "
+         "exact-greedy speculative decoding + checkpoint serving",
          False, "jnp/XLA + host scheduler")
 register("obs", "metrics registry + span tracing + Prometheus/Chrome-trace exporters",
          False, "host-side stdlib")

@@ -234,6 +234,13 @@ class LlamaAttention(nn.Module):
           masked, fixed-extent path decode uses, and chunk logits are
           bit-identical to the shape-stable uncached forward (context
           padded to ``max_len``) no matter how the prompt is split.
+          This mode also carries **speculative verification**
+          (``DecodeEngine.verify_draft``): the per-ROW logits it
+          returns are each bit-identical to the single-token decode
+          logits at that depth (same reduction extents), so comparing
+          row ``i``'s argmax against a drafted token ``i+1`` is an
+          *exact* accept/reject test — speculation changes scheduling,
+          never a bit of the emitted stream.
         - **decode** (``s == 1``): ``position`` is a ``[b]`` vector of
           per-slot depths; rope is applied at the true position, the new
           K/V are appended at ``position``, and attention reads the full
@@ -407,7 +414,9 @@ class LlamaForCausalLM(nn.Module):
         the call returns ``(logits, kv_cache)`` instead of logits/loss:
         ``input_ids [1, s>1]`` + ``slot`` (+ scalar ``position`` = the
         chunk's start offset, 0/None for a fresh prompt) prefills one
-        chunk of one slot, ``input_ids [slots, 1]`` + ``position
+        chunk of one slot — the serving engine slices the last real
+        row's logits for prefill and keeps EVERY row for speculative
+        verification — and ``input_ids [slots, 1]`` + ``position
         [slots]`` runs one batched decode step (see
         :class:`apex_tpu.serving.engine.DecodeEngine`).  ``labels``
         is a training-only argument and rejected in serving mode.  The

@@ -23,8 +23,8 @@ idempotent.  The default log sink is untouched: ``emit_event`` output
 stays byte-identical with or without the bridge.
 
 Serving **gauges** (queue depth, slot occupancy, cache utilization,
-prefill backlog, decode compiles) are declared here but *set directly*
-by the scheduler each step — a gauge describes current state, and
+prefill backlog, decode compiles, speculation speedup) are declared
+here but *set directly* by the scheduler each step — a gauge describes current state, and
 routing it through the event stream would tie its freshness to
 ``log_interval``.  Pipeline timers publish through
 :data:`TIMER_SECONDS` via ``Timers.publish_metrics()``.
@@ -94,6 +94,26 @@ SERVING_PREFILL_BACKLOG = metrics.gauge(
     "apex_serving_prefill_backlog",
     "prompt tokens admitted or queued but not yet cached (deferred by "
     "the per-step prefill budget)")
+SERVING_SPEC_DRAFTED = metrics.counter(
+    "apex_serving_spec_drafted_total",
+    "draft tokens proposed by prompt lookup (speculative decode)")
+SERVING_SPEC_ACCEPTED = metrics.counter(
+    "apex_serving_spec_accepted_total",
+    "drafted tokens the verify forward's greedy argmax accepted")
+SERVING_SPEC_REJECTED = metrics.counter(
+    "apex_serving_spec_rejected_total",
+    "drafted tokens rejected at verification (rolled back, never "
+    "emitted)")
+SERVING_SPEC_ACCEPT_LENGTH = metrics.histogram(
+    "apex_serving_spec_accepted_tokens",
+    "accepted draft length per verify dispatch (0 == immediate "
+    "rejection; the distribution behind the speculation speedup)",
+    buckets=tuple(float(b) for b in (0, 1, 2, 3, 4, 6, 8, 12, 16, 24,
+                                     32)))
+SERVING_SPEC_SPEEDUP = metrics.gauge(
+    "apex_serving_spec_speedup",
+    "tokens emitted per verify dispatch on the speculative path "
+    "(1.0 == plain decode's one token per dispatch)")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -155,6 +175,20 @@ def _on_serving_prefill_chunk(event: dict) -> None:
         SERVING_PREFILL_DURATION.observe(duration_s, bucket=str(bucket))
 
 
+def _on_serving_spec_verify(event: dict) -> None:
+    drafted = _measurement(event, "drafted")
+    accepted = _measurement(event, "accepted")
+    # drafted/accepted travel together (the scheduler emits both); a
+    # malformed event is skipped whole rather than half-counted, so the
+    # rejected = drafted - accepted identity survives any input
+    if drafted is None or accepted is None or not 0 <= accepted <= drafted:
+        return
+    SERVING_SPEC_DRAFTED.inc(drafted)
+    SERVING_SPEC_ACCEPTED.inc(accepted)
+    SERVING_SPEC_REJECTED.inc(drafted - accepted)
+    SERVING_SPEC_ACCEPT_LENGTH.observe(accepted)
+
+
 def _on_serving_request_finished(event: dict) -> None:
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
@@ -175,6 +209,7 @@ _HANDLERS = {
     "checkpoint_rejected": _on_checkpoint_rejected,
     "serving_first_token": _on_serving_first_token,
     "serving_prefill_chunk": _on_serving_prefill_chunk,
+    "serving_spec_verify": _on_serving_spec_verify,
     "serving_request_finished": _on_serving_request_finished,
 }
 

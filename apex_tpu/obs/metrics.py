@@ -26,8 +26,9 @@ is the apex_tpu equivalent, deliberately dependency-free:
 - **Naming is linted.**  Metric names must match ``^apex_[a-z0-9_]+$``
   (enforced here at registration AND statically by
   ``tools/check_metrics.py``); counters end in ``_total``, histograms
-  carry a unit suffix (``_seconds`` / ``_bytes``).  The conventions and
-  the full metric inventory live in ``docs/api/observability.md``.
+  carry a unit suffix (``_seconds`` / ``_bytes`` / ``_tokens``).  The
+  conventions and the full metric inventory live in
+  ``docs/api/observability.md``.
 
 Updates are thread-safe (the supervisor's watchdog monitor thread and
 the serving host loop write concurrently); reads (:func:`snapshot`,

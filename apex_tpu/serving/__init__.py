@@ -21,6 +21,15 @@ head matmul), its amp policies, and its resilience checkpoints:
   greedy/temperature/top-k sampling from explicit PRNG keys.  Prefill
   AND cached incremental decode are bit-identical to the shape-stable
   uncached full-context forward (the tier-1 acceptance tests).
+- :mod:`.draft` — prompt-lookup drafting for **exact-greedy
+  speculative decoding**: a host-side longest-suffix n-gram match over
+  each request's prompt + generated history proposes up to k candidate
+  tokens (no draft model, zero device cost); the engine's bucketed
+  **verify** program scores all k+1 positions in one cached
+  multi-token forward and accepts the longest prefix the target's own
+  greedy argmax agrees with — the emitted stream is bit-identical to
+  plain one-token decode by construction, and the per-request draft
+  length adapts to the measured acceptance.
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: bounded
   FIFO queue, slot admission at step boundaries, a per-step
   ``prefill_budget`` (in tokens) that interleaves prompt chunks with
@@ -51,8 +60,10 @@ End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
     results = sched.run()              # rid -> RequestResult
 """
 
+from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import (
     DecodeEngine,
+    default_draft_buckets,
     default_prefill_buckets,
     request_key,
     sample_tokens,
@@ -83,7 +94,11 @@ __all__ = [
     "release_slot",
     "valid_token_mask",
     "DecodeEngine",
+    "SpeculationConfig",
+    "adapt_k",
+    "default_draft_buckets",
     "default_prefill_buckets",
+    "propose",
     "request_key",
     "sample_tokens",
     "token_key",

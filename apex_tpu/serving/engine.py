@@ -1,17 +1,21 @@
-"""DecodeEngine: bucketed chunked prefill + single-token decode over a
-KV cache.
+"""DecodeEngine: bucketed chunked prefill + single-token decode +
+speculative multi-token verify over a KV cache.
 
 Wraps :class:`~apex_tpu.models.llama.LlamaForCausalLM` with a *bounded*
 set of compiled programs — one **prefill chunk** program per bucket in
 a small power-of-two bucket table (a short prompt costs a short
-dispatch instead of a full ``prefill_len``-sized one) and exactly one
-**batched decode step** (one token per slot) — all shape-stable by
-construction: a chunk is padded to the smallest covering bucket, decode
-always runs all ``slots`` lanes, and the cache is preallocated
-(:mod:`apex_tpu.serving.kv_cache`).  After warmup the decode jit cache
-holds exactly one entry and the prefill jit cache at most one entry per
-bucket, no matter how requests arrive (`tests/test_serving.py` asserts
-both through :func:`apex_tpu.utils.compat.compile_count`).
+dispatch instead of a full ``prefill_len``-sized one), exactly one
+**batched decode step** (one token per slot), and one **speculative
+verify** program per entry in a small ``draft_buckets`` table (scores
+a pending token plus up to ``max_draft`` drafted candidates in one
+cached multi-token forward — see :meth:`DecodeEngine.verify_draft`) —
+all shape-stable by construction: chunks and drafts are padded to the
+smallest covering bucket, decode always runs all ``slots`` lanes, and
+the cache is preallocated (:mod:`apex_tpu.serving.kv_cache`).  After
+warmup the decode jit cache holds exactly one entry and the prefill /
+verify jit caches at most one entry per bucket, no matter how requests
+arrive (`tests/test_serving.py` / `tests/test_serving_spec.py` assert
+all three through :func:`apex_tpu.utils.compat.compile_count`).
 
 Prompts longer than ``prefill_len`` are served by **chunked cached
 prefill**: the prompt is split into ``prefill_len``-sized chunks (tail
@@ -53,11 +57,17 @@ import numpy as np
 from jax import lax
 
 from apex_tpu._logging import get_logger
-from apex_tpu.serving.kv_cache import KVCache, init_cache
+from apex_tpu.serving.kv_cache import (
+    KVCache,
+    commit_slot_length,
+    init_cache,
+    release_slot,
+)
 from apex_tpu.utils.compat import compile_count
 
-__all__ = ["DecodeEngine", "default_prefill_buckets", "sample_tokens",
-           "request_key", "token_key"]
+__all__ = ["DecodeEngine", "default_prefill_buckets",
+           "default_draft_buckets", "sample_tokens", "request_key",
+           "token_key"]
 
 logger = get_logger("serving.engine")
 
@@ -118,6 +128,27 @@ def default_prefill_buckets(prefill_len: int,
     return tuple(out)
 
 
+def default_draft_buckets(max_draft: int) -> tuple:
+    """Power-of-two draft-length table ``(1, 2, 4, ..., max_draft)`` —
+    the compile-count budget of the speculative verify path.
+
+    A k-token draft is padded to the smallest covering bucket (the
+    verify program's width is ``bucket + 1``: the pending token plus
+    the padded draft), so the number of distinct compiled verify
+    programs stays ``len(buckets)`` — logarithmic in ``max_draft``,
+    bounded and asserted via :meth:`DecodeEngine.verify_compiles`
+    exactly like the prefill buckets.
+    """
+    if max_draft < 1:
+        raise ValueError(f"max_draft must be >= 1, got {max_draft}")
+    out, b = [], 1
+    while b < max_draft:
+        out.append(b)
+        b *= 2
+    out.append(max_draft)
+    return tuple(out)
+
+
 def request_key(seed: int) -> jax.Array:
     """Base PRNG key for one request (explicit, replayable)."""
     return jax.random.PRNGKey(seed)
@@ -149,6 +180,7 @@ class DecodeEngine:
     def __init__(self, model, params, *, slots: int = 8,
                  max_len: int = 512, prefill_len: int = 64,
                  prefill_buckets: Optional[Sequence[int]] = None,
+                 draft_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=None):
         if prefill_len < 2:
             raise ValueError("prefill_len must be >= 2 (a length-1 "
@@ -174,12 +206,30 @@ class DecodeEngine:
                 f"the largest prefill bucket must equal prefill_len "
                 f"{prefill_len} (it is the full-chunk program), got "
                 f"{buckets}")
+        if draft_buckets is None:
+            # a verify writes bucket+1 rows, so the widest default
+            # draft must leave room in even the smallest cache
+            draft_buckets = default_draft_buckets(min(8, int(max_len) - 1))
+        dbuckets = tuple(int(b) for b in draft_buckets)
+        if not dbuckets or list(dbuckets) != sorted(set(dbuckets)):
+            raise ValueError(f"draft_buckets must be non-empty, strictly "
+                             f"ascending ints, got {dbuckets}")
+        if dbuckets[0] < 1:
+            raise ValueError(f"draft buckets must be >= 1 (a 0-token "
+                             f"draft has nothing to verify), got "
+                             f"{dbuckets}")
+        if dbuckets[-1] >= int(max_len):
+            raise ValueError(
+                f"largest draft bucket {dbuckets[-1]} must be < max_len "
+                f"{max_len} (a verify writes bucket+1 rows into the "
+                f"cache)")
         self.model = model
         self.params = params
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
         self.prefill_buckets = buckets
+        self.draft_buckets = dbuckets
         if cache_dtype is None:
             # serve in the params' own precision (bf16 params -> bf16
             # cache); fall back to f32 for exotic all-int trees
@@ -212,9 +262,7 @@ class DecodeEngine:
             # chunk) + the filled cache.
             logits, cache = model.apply(params, ids, kv_cache=cache,
                                         slot=slot, position=offset)
-            cache = dataclasses.replace(
-                cache,
-                lengths=cache.lengths.at[slot].set(offset + length))
+            cache = commit_slot_length(cache, slot, offset + length)
             last = lax.dynamic_index_in_dim(logits[:, 0, :], length - 1,
                                             axis=0, keepdims=False)
             return last.astype(jnp.float32), cache
@@ -231,11 +279,39 @@ class DecodeEngine:
                 lengths=cache.lengths + active.astype(jnp.int32))
             return logits[0].astype(jnp.float32), cache
 
+        def _verify(params, cache, ids, slot, offset, length):
+            # ids [1, W] where W = draft_bucket + 1: the slot's PENDING
+            # token (sampled but not yet cached — decode's invariant)
+            # followed by the (padded) draft.  Runs the chunked-prefill
+            # machinery — rope at the true positions, K/V written at
+            # offset.., per-row causal bounds over the whole masked
+            # cache — but keeps EVERY row's logits instead of slicing
+            # the last one: row i is the next-token distribution after
+            # ids[0, :i+1], bit-identical to the single-token decode
+            # logits at that depth (same fixed-extent reductions).
+            # Acceptance runs on device so dispatch + rollback is ONE
+            # program: a = longest prefix where the target's own argmax
+            # agrees with the draft (only the length-1 REAL draft rows
+            # count), and the length commit rolls the slot back to
+            # offset + a + 1 — the rejected rows' K/V become unreadable
+            # in the same program that wrote them.
+            logits, cache = model.apply(params, ids, kv_cache=cache,
+                                        slot=slot, position=offset)
+            rows = logits[:, 0, :].astype(jnp.float32)   # [W, vocab]
+            greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)
+            w = ids.shape[1]
+            real = jnp.arange(w - 1, dtype=jnp.int32) < (length - 1)
+            match = (greedy[:-1] == ids[0, 1:]) & real
+            accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32)))
+            cache = commit_slot_length(cache, slot, offset + accepted + 1)
+            return greedy, rows, accepted.astype(jnp.int32), cache
+
         # the cache argument is donated: the engine discards the old
         # functional copy on every call, and without aliasing each
         # one-token step would copy the whole preallocated k/v pair
         self._prefill = jax.jit(_prefill, donate_argnums=(1,))
         self._decode = jax.jit(_decode, donate_argnums=(1,))
+        self._verify = jax.jit(_verify, donate_argnums=(1,))
         logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
                      "buckets=%s cache_dtype=%s", self.slots,
                      self.max_len, self.prefill_len,
@@ -269,8 +345,6 @@ class DecodeEngine:
 
     def release(self, slot: int) -> None:
         """Evict a slot (O(1)); its bytes stay masked until overwritten."""
-        from apex_tpu.serving.kv_cache import release_slot
-
         self._check_slot(slot)
         self._cache = release_slot(self._cache, slot)
         self._lengths_host[slot] = 0
@@ -292,12 +366,34 @@ class DecodeEngine:
         shape), asserted in tier-1 and by the bench regression guard."""
         return compile_count(self._prefill)
 
+    def verify_compiles(self) -> int:
+        """Number of distinct compiles of the speculative verify
+        program — bounded by ``len(draft_buckets)`` (each bucket is one
+        input width), asserted in tier-1 and by the bench regression
+        guard.  Zero until the first :meth:`verify_draft` call — the
+        witness that disabling speculation leaves the compiled-program
+        set untouched."""
+        return compile_count(self._verify)
+
+    @property
+    def max_draft(self) -> int:
+        """Widest draft :meth:`verify_draft` accepts (the largest
+        draft bucket)."""
+        return self.draft_buckets[-1]
+
     def bucket_for(self, n: int) -> int:
         """Smallest prefill bucket covering an ``n``-token chunk."""
         if not 1 <= n <= self.prefill_len:
             raise ValueError(f"chunk length {n} not in [1, "
                              f"{self.prefill_len}]")
         return next(b for b in self.prefill_buckets if b >= n)
+
+    def draft_bucket_for(self, k: int) -> int:
+        """Smallest draft bucket covering a ``k``-token draft."""
+        if not 1 <= k <= self.draft_buckets[-1]:
+            raise ValueError(f"draft length {k} not in [1, "
+                             f"{self.draft_buckets[-1]}]")
+        return next(b for b in self.draft_buckets if b >= k)
 
     # ---- the compiled programs -------------------------------------------
     def prefill_chunk(self, slot: int, tokens: Sequence[int]) -> jax.Array:
@@ -377,6 +473,59 @@ class DecodeEngine:
             jnp.asarray(tokens, jnp.int32), jnp.asarray(act))
         self._lengths_host[act] += 1
         return logits
+
+    def verify_draft(self, slot: int, tokens: Sequence[int]
+                     ) -> tuple[int, np.ndarray, jax.Array]:
+        """One speculative verify: score ``tokens`` (the slot's pending
+        last-sampled token followed by 1..``max_draft`` drafted
+        candidates) in ONE cached multi-token forward, accept the
+        longest draft prefix the target's greedy argmax agrees with,
+        and roll the slot back to the accepted depth.
+
+        Returns ``(accepted, greedy, logits)``: ``accepted`` = draft
+        tokens accepted (0 == immediate rejection); ``greedy[i]`` =
+        the target's argmax after ``tokens[:i+1]`` (so the step emits
+        ``tokens[1:1+accepted] + [greedy[accepted]]`` — the accepted
+        draft plus the bonus token the verify forward computed for
+        free, exactly the stream ``accepted + 1`` plain decode steps
+        would emit, bit for bit); ``logits`` = the per-row f32
+        next-token distributions ``[bucket+1, vocab]`` (rows past
+        ``accepted`` scored rejected/padded context — valid for
+        inspection, already rolled back on device).
+
+        The draft is padded to the smallest covering ``draft_buckets``
+        entry (one compile per bucket, ever — padded rows' K/V land
+        past the committed length, unreadable like every other masked
+        byte).  After the call the slot's length is
+        ``offset + accepted + 1``: the pending token and accepted
+        draft are cached, the bonus token is the new pending token —
+        the same invariant a plain decode step leaves.
+        """
+        self._check_slot(slot)
+        k = len(tokens) - 1
+        if k < 1:
+            raise ValueError(
+                f"verify_draft needs the pending token plus >= 1 draft "
+                f"token, got {len(tokens)} token(s) — with no draft to "
+                f"verify, run the plain decode step")
+        bucket = self.draft_bucket_for(k)    # raises past max_draft
+        offset = int(self._lengths_host[slot])
+        if offset == 0:
+            raise ValueError(
+                f"slot {slot} was never prefilled — a verify would "
+                f"expose garbage as its whole context")
+        if offset + k + 1 > self.max_len:
+            raise ValueError(
+                f"verify of {k + 1} tokens at offset {offset} overruns "
+                f"cache max_len {self.max_len}")
+        ids = np.zeros((1, bucket + 1), np.int32)
+        ids[0, :k + 1] = np.asarray(tokens, np.int32)
+        greedy, rows, accepted, self._cache = self._verify(
+            self.params, self._cache, jnp.asarray(ids), jnp.int32(slot),
+            jnp.int32(offset), jnp.int32(k + 1))
+        a = int(accepted)
+        self._lengths_host[slot] = offset + a + 1
+        return a, np.asarray(greedy), rows
 
     # ---- sampling --------------------------------------------------------
     @staticmethod

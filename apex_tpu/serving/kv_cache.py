@@ -42,7 +42,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["KVCache", "init_cache", "prefill_into_slot", "append_token",
-           "release_slot", "valid_token_mask"]
+           "commit_slot_length", "release_slot", "valid_token_mask"]
 
 
 @functools.partial(jax.tree_util.register_dataclass,
@@ -139,6 +139,25 @@ def append_token(cache: KVCache, layer: int, k_tok, v_tok,
                                                     pos)),
         v=cache.v.at[layer].set(jax.vmap(write_one)(cache.v[layer], v_tok,
                                                     pos)))
+
+
+def commit_slot_length(cache: KVCache, slot, length) -> KVCache:
+    """Set one slot's valid-token count (``slot``/``length`` may be
+    traced scalars) — the single length-commit primitive both write
+    paths share.
+
+    A prefill chunk commits ``offset + chunk_len`` after writing its
+    rows; a speculative **verify** commits ``offset + accepted + 1`` —
+    i.e. it *rolls back* past the rejected draft rows, whose K/V were
+    written but (because every read masks at ``idx <= length - 1``)
+    are unreadable from the moment this commit lands.  Rollback is
+    therefore the same O(1) move as eviction: adjust the length, never
+    touch the payload.
+    """
+    return dataclasses.replace(
+        cache,
+        lengths=cache.lengths.at[jnp.asarray(slot)].set(
+            jnp.asarray(length, jnp.int32)))
 
 
 def release_slot(cache: KVCache, slot) -> KVCache:

@@ -26,11 +26,25 @@ boundaries:
 - **Per-request state machine**: QUEUED → PREFILL → DECODE → DONE, with
   eviction on EOS or ``max_new_tokens`` and *immediate* slot reuse at
   the same step boundary.
+- **Exact-greedy speculation** (opt-in via
+  ``speculation=SpeculationConfig(...)``): greedy requests draft up to
+  k tokens per step by prompt lookup (:mod:`apex_tpu.serving.draft`)
+  and verify them in one multi-token dispatch
+  (:meth:`~apex_tpu.serving.engine.DecodeEngine.verify_draft`),
+  emitting the accepted prefix plus a bonus token — the stream is
+  bit-identical to plain decode by construction.  The draft length
+  adapts per request (double on full accept, halve on rejection);
+  no-match streams and sampled-temperature requests ride the plain
+  batched decode step, the latter byte-for-byte (no drafting, no
+  verify compiles, no extra events or metrics).
 - **Telemetry**: structured ``emit_event`` lines
   (:mod:`apex_tpu._logging`) — ``serving_request_admitted`` /
   ``serving_prefill_chunk`` (per-chunk bucket + dispatch wall time,
   feeding the ``apex_serving_prefill_duration_seconds{bucket}``
-  histogram) / ``serving_first_token`` (time-to-first-token) /
+  histogram) / ``serving_spec_verify`` (per-verify drafted/accepted
+  counts + dispatch wall time, feeding the speculation counters and
+  the ``apex_serving_spec_accepted_tokens`` histogram) /
+  ``serving_first_token`` (time-to-first-token) /
   ``serving_request_finished`` (tokens/s, mean per-token latency) per
   request, and a ``serving_step`` sample (queue depth, active slots,
   slot occupancy, KV-cache utilization, prefill backlog) every
@@ -58,6 +72,7 @@ import numpy as np
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import bridge as obs_bridge
+from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import DecodeEngine, request_key
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
@@ -117,6 +132,7 @@ class _Active:
     t_first: float
     prompt_pos: int = 0      # prompt tokens cached so far
     phase: RequestPhase = RequestPhase.PREFILL
+    draft_k: int = 0         # adaptive draft length (speculation only)
 
     @property
     def prompt_remaining(self) -> int:
@@ -141,22 +157,37 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine: DecodeEngine, *, max_queue: int = 64,
                  log_interval: int = 32,
                  prefill_budget: Optional[int] = None,
+                 speculation: Optional[SpeculationConfig] = None,
                  clock: Callable[[], float] = time.monotonic):
         if prefill_budget is None:
             prefill_budget = engine.prefill_len
         if prefill_budget < 1:
             raise ValueError(f"prefill_budget must be >= 1 token per "
                              f"step, got {prefill_budget}")
+        if (speculation is not None
+                and speculation.max_draft > engine.max_draft):
+            raise ValueError(
+                f"speculation.max_draft {speculation.max_draft} exceeds "
+                f"the engine's draft bucket table (max "
+                f"{engine.max_draft}) — widen draft_buckets or narrow "
+                f"the config")
         self.engine = engine
         self.max_queue = int(max_queue)
         self.log_interval = max(1, int(log_interval))
         self.prefill_budget = int(prefill_budget)
+        self.speculation = speculation
         self._clock = clock
         self._queue: deque[tuple[Request, float]] = deque()
         self._active: Dict[int, _Active] = {}
         self._results: Dict[str, RequestResult] = {}
         self._step_index = 0
         self._admit_seq = 0
+        # cumulative speculative-path accounting (host ints; the
+        # speedup gauge and bench read these)
+        self._spec_dispatches = 0
+        self._spec_emitted = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
 
     # ---- submission ------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -209,6 +240,17 @@ class ContinuousBatchingScheduler:
     def steps_run(self) -> int:
         return self._step_index
 
+    @property
+    def spec_stats(self) -> Dict[str, int]:
+        """Cumulative speculative-path accounting: verify ``dispatches``,
+        ``drafted`` / ``accepted`` draft tokens, and ``emitted`` tokens
+        (accepted + the per-verify bonus token).  All zero when
+        speculation is off or bypassed — the escape-hatch witness."""
+        return {"dispatches": self._spec_dispatches,
+                "drafted": self._spec_drafted,
+                "accepted": self._spec_accepted,
+                "emitted": self._spec_emitted}
+
     def phase_of(self, rid: str) -> RequestPhase:
         if rid in self._results:
             return RequestPhase.DONE
@@ -234,9 +276,19 @@ class ContinuousBatchingScheduler:
                 break
             request, t_submit = self._queue.popleft()
             slot = free[0]
+            # per-request draft state: greedy requests under an enabled
+            # speculation config start at the widest draft (adapt_k
+            # narrows it on rejection); sampled-temperature requests get
+            # draft_k=0 — drafting is BYPASSED for them and their whole
+            # path (events, metrics, compiled programs) stays
+            # byte-for-byte the plain one
+            draft_k = (self.speculation.max_draft
+                       if self.speculation is not None
+                       and request.temperature <= 0 else 0)
             st = _Active(request=request, slot=slot, seq=self._admit_seq,
                          base_key=np.asarray(request_key(request.seed)),
-                         tokens=[], t_submit=t_submit, t_first=0.0)
+                         tokens=[], t_submit=t_submit, t_first=0.0,
+                         draft_k=draft_k)
             self._admit_seq += 1
             self._active[slot] = st
             logger.debug("admitted %s into slot %d (queue %d deep)",
@@ -318,6 +370,74 @@ class ContinuousBatchingScheduler:
                    per_token_ms=round(decode_s / decode_steps * 1e3, 3))
         return True
 
+    def _spec_work(self, decoding: Dict[int, "_Active"]
+                   ) -> tuple[List[str], set]:
+        """Run one speculative verify per eligible decoding slot: draft
+        by prompt lookup over the request's own prompt + generated
+        history, verify all candidates in one multi-token dispatch,
+        emit the accepted prefix plus the bonus token, and adapt the
+        next draft length.  Returns ``(finished rids, slots consumed)``
+        — consumed slots already advanced this step and must not ride
+        the batched decode.
+
+        A slot falls back to the plain decode step whenever drafting
+        cannot help: sampled-temperature request (``draft_k == 0`` —
+        never even looked up), no n-gram match, fewer than 2 tokens of
+        output budget left, or no cache room for a draft.  The
+        emitted stream is bit-identical to plain decode by
+        construction (acceptance compares the target's own argmax), so
+        speculation is pure scheduling — pinned by
+        ``tests/test_serving_spec.py``.
+        """
+        finished: List[str] = []
+        consumed: set = set()
+        cfg = self.speculation
+        lengths = self.engine.lengths()
+        for slot, st in sorted(decoding.items()):
+            request = st.request
+            if st.draft_k < 1:
+                continue                 # sampling path: bypassed
+            remaining = request.max_new_tokens - len(st.tokens)
+            # a draft of k emits at most k+1 tokens; k is capped so a
+            # full accept lands exactly on max_new_tokens, and a
+            # remaining budget of 1 (or a full cache) is cheaper as one
+            # plain decode lane than a 2-wide verify
+            cap = min(st.draft_k, remaining - 1,
+                      self.engine.max_len - int(lengths[slot]) - 1)
+            if cap < 1:
+                continue
+            draft = propose(list(request.prompt) + st.tokens, cap,
+                            ngram_max=cfg.ngram_max,
+                            ngram_min=cfg.ngram_min)
+            if not draft:
+                continue                 # no match: plain decode lane
+            t0 = self._clock()
+            accepted, greedy, _ = self.engine.verify_draft(
+                slot, [st.tokens[-1]] + draft)
+            dt = self._clock() - t0
+            consumed.add(slot)
+            st.draft_k = adapt_k(st.draft_k, len(draft), accepted, cfg)
+            self._spec_dispatches += 1
+            self._spec_drafted += len(draft)
+            self._spec_accepted += accepted
+            # the accepted draft plus the verify's free bonus token —
+            # appended one at a time so an EOS inside the batch
+            # truncates the stream exactly where plain decode would
+            # have stopped
+            n_emitted = 0
+            for tok in draft[:accepted] + [int(greedy[accepted])]:
+                st.tokens.append(int(tok))
+                n_emitted += 1
+                if self._finish_if_done(st):
+                    finished.append(request.rid)
+                    break
+            self._spec_emitted += n_emitted
+            emit_event("serving_spec_verify", rid=request.rid,
+                       bucket=self.engine.draft_bucket_for(len(draft)),
+                       drafted=len(draft), accepted=accepted,
+                       emitted=n_emitted, duration_s=round(dt, 6))
+        return finished, consumed
+
     @property
     def prefill_backlog(self) -> int:
         """Deferred prefill work, in prompt tokens: what the budget has
@@ -335,6 +455,16 @@ class ContinuousBatchingScheduler:
         finished = self._prefill_work()
         decoding = {slot: st for slot, st in self._active.items()
                     if st.phase is RequestPhase.DECODE}
+        if decoding and self.speculation is not None:
+            # speculative verifies run between the prefill budget and
+            # the shared decode step; slots they advanced are excluded
+            # from this step's decode (they already emitted), everyone
+            # else — sampled requests, no-match streams, mid-prefill
+            # lanes — proceeds exactly as before
+            spec_finished, consumed = self._spec_work(decoding)
+            finished.extend(spec_finished)
+            decoding = {slot: st for slot, st in decoding.items()
+                        if slot not in consumed}
         if decoding:
             slots = self.engine.slots
             tokens = np.zeros((slots,), np.int32)
@@ -378,6 +508,14 @@ class ContinuousBatchingScheduler:
         # a scrape during the first log_interval steps must not read 0
         # for a gauge documented as "1 == shape-stable"
         obs_bridge.SERVING_DECODE_COMPILES.set(self.engine.decode_compiles())
+        if self._spec_dispatches:
+            # tokens emitted per verify dispatch — the amortization the
+            # speculative path actually delivered (1.0 == plain
+            # decode's rate).  Only ever set once a verify has run, so
+            # a speculation-off (or all-sampled) run leaves the metric
+            # stream untouched — the escape-hatch identity contract
+            obs_bridge.SERVING_SPEC_SPEEDUP.set(
+                self._spec_emitted / self._spec_dispatches)
         if self._step_index % self.log_interval == 0:
             emit_event("serving_step", step=self._step_index,
                        queue_depth=len(self._queue),

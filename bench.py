@@ -641,6 +641,117 @@ def _serving_metrics(*, decode_tokens: int = 48, prompt_len: int = 5,
     }
 
 
+def _serving_spec_metrics(*, decode_tokens: int = 96, prompt_len: int = 48,
+                          prefill_len: int = 64, max_len: int = 160,
+                          slots: int = 4, attempts: int = 3,
+                          max_draft: int = 8) -> dict:
+    """Speculative-decode speedup (the BENCH_*.json ``serving_spec``
+    block): greedy single-stream decode with prompt-lookup drafting +
+    batched multi-token verification vs plain one-token decode, on two
+    workloads — an acceptance-friendly *repetitive* prompt (the
+    summarize/code-edit/RAG traffic class prompt lookup exists for;
+    bar >= 1.8x) and an *adversarial* random-token prompt (the drafter
+    rarely helps; bar >= 1.0x, i.e. the fall-back path must not
+    regress).  Both sides run the same scheduler loop on warm engines,
+    best-of-N attempts timed back to back (the serving-block pattern);
+    the spec stream is asserted token-identical to the plain stream —
+    the speedup is scheduling, never sampling drift.  Compile-count
+    regression guards ride along: ``verify_compiles`` bounded by the
+    draft bucket table, ``decode_compiles == 1`` untouched."""
+    from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+    from apex_tpu.serving import (ContinuousBatchingScheduler, DecodeEngine,
+                                  Request, SpeculationConfig)
+
+    # the serving block's model (big enough that a dispatch costs real
+    # compute) with a longer cache: the speculation win is a
+    # decode-phase effect, so the workload is decode-heavy
+    cfg = LlamaConfig(vocab_size=256, hidden_size=384,
+                      intermediate_size=768,
+                      num_hidden_layers=3, num_attention_heads=4,
+                      num_key_value_heads=2,
+                      max_position_embeddings=max_len)
+    model = LlamaForCausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 5), jnp.int32))
+    rng = np.random.default_rng(0)
+    motif = [int(x) for x in rng.integers(0, cfg.vocab_size, 8)]
+    workloads = {
+        # a repeated motif: generation collapses into the pattern the
+        # history already contains, so the lookup drafts it
+        "repetitive": (motif * ((prompt_len + 7) // 8))[:prompt_len],
+        # incompressible prompt: drafting mostly finds nothing/garbage
+        "adversarial": [int(x) for x in rng.integers(0, cfg.vocab_size,
+                                                     prompt_len)],
+    }
+    spec_cfg = SpeculationConfig(max_draft=max_draft)
+    eng_plain = DecodeEngine(model, params, slots=slots, max_len=max_len,
+                             prefill_len=prefill_len)
+    eng_spec = DecodeEngine(model, params, slots=slots, max_len=max_len,
+                            prefill_len=prefill_len)
+
+    def run_once(eng, speculation, prompt, tag):
+        """One timed single-stream drain; returns (tokens/s, tokens,
+        scheduler)."""
+        sched = ContinuousBatchingScheduler(eng, log_interval=10 ** 9,
+                                            speculation=speculation)
+        sched.submit(Request(tag, prompt, max_new_tokens=decode_tokens))
+        t0 = time.perf_counter()
+        result = sched.run()[tag]
+        dt = time.perf_counter() - t0
+        return len(result.tokens) / max(dt, 1e-9), result.tokens, sched
+
+    # warmup: every compile either side will ever need (decode, the
+    # prompt's prefill buckets, and — for the spec engine — the verify
+    # buckets the adaptive controller actually visits on each workload)
+    for name, prompt in workloads.items():
+        run_once(eng_plain, None, prompt, f"warm_p_{name}")
+        run_once(eng_spec, spec_cfg, prompt, f"warm_s_{name}")
+
+    out_workloads = {}
+    for wi, (name, prompt) in enumerate(workloads.items()):
+        best = None
+        for attempt in range(max(1, attempts)):
+            plain_tps, plain_toks, _ = run_once(
+                eng_plain, None, prompt, f"p{wi}_{attempt}")
+            spec_tps, spec_toks, sched = run_once(
+                eng_spec, spec_cfg, prompt, f"s{wi}_{attempt}")
+            assert spec_toks == plain_toks, (
+                f"{name}: speculative stream diverged from plain decode "
+                f"— exactness broken")
+            if best is None or spec_tps / plain_tps > best[0] / best[1]:
+                best = (spec_tps, plain_tps, sched.spec_stats)
+        spec_tps, plain_tps, stats = best
+        out_workloads[name] = {
+            "tokens_per_s_plain": round(plain_tps, 1),
+            "tokens_per_s_spec": round(spec_tps, 1),
+            "speedup": round(spec_tps / max(plain_tps, 1e-9), 2),
+            "verify_dispatches": stats["dispatches"],
+            "drafted": stats["drafted"],
+            "accepted": stats["accepted"],
+            "tokens_per_dispatch": round(
+                stats["emitted"] / max(stats["dispatches"], 1), 2),
+            "accept_rate": round(
+                stats["accepted"] / max(stats["drafted"], 1), 3),
+        }
+    return {
+        "ok": True,
+        "streams_identical": True,       # asserted above, every attempt
+        "speedup_repetitive": out_workloads["repetitive"]["speedup"],
+        "speedup_adversarial": out_workloads["adversarial"]["speedup"],
+        "workloads": out_workloads,
+        # regression guards: bounded by the draft bucket table / the
+        # one-decode-compile contract, not hoped
+        "draft_buckets": list(eng_spec.draft_buckets),
+        "verify_compiles": eng_spec.verify_compiles(),
+        "decode_compiles": max(eng_plain.decode_compiles(),
+                               eng_spec.decode_compiles()),
+        "config": {"slots": slots, "max_len": max_len,
+                   "prefill_len": prefill_len, "prompt_len": prompt_len,
+                   "decode_tokens": decode_tokens,
+                   "max_draft": max_draft, "attempts": attempts},
+    }
+
+
 def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
     """Observability tax of the ISSUE-6 layer (the BENCH_*.json ``obs``
     block): per-update cost of each instrument kind, span enter/exit
@@ -872,6 +983,11 @@ def run_config(name: str, *, batch: int | None = None,
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         serving = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        serving_spec = _serving_spec_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        serving_spec = {"ok": False,
+                        "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         obs = _obs_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
@@ -890,6 +1006,7 @@ def run_config(name: str, *, batch: int | None = None,
         "supervisor": supervisor,
         "elastic": elastic,
         "serving": serving,
+        "serving_spec": serving_spec,
         "obs": obs,
         "config": out_cfg,
     }

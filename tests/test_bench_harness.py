@@ -311,6 +311,43 @@ def test_serving_metrics_block():
     assert r["config"]["slots"] == 4
 
 
+def test_serving_spec_metrics_block():
+    """The speculative-decode block (ISSUE 9): spec-vs-plain greedy
+    decode tokens/s on an acceptance-friendly repetitive workload
+    (bar >= 1.8x) and an adversarial random-token workload (bar >=
+    1.0x — the fall-back path must not regress), with the exactness
+    witness (streams token-identical every attempt) and BOTH
+    compile-count regression guards: verify compiles bounded by the
+    draft bucket table, decode compiles == 1 unchanged."""
+    r = bench._serving_spec_metrics(attempts=1)
+    assert r["ok"] is True
+    # exactness is asserted inside the block on EVERY attempt — a
+    # speedup from a diverged stream would be a lie, not a win
+    assert r["streams_identical"] is True
+    # the ISSUE-9 acceptance bars
+    assert r["speedup_repetitive"] >= 1.8, r
+    assert r["speedup_adversarial"] >= 1.0, r
+    # compile-count guards: bounded by the draft bucket table, and the
+    # batched decode step still compiles exactly once
+    assert r["draft_buckets"] == [1, 2, 4, 8]
+    assert 1 <= r["verify_compiles"] <= len(r["draft_buckets"])
+    assert r["decode_compiles"] == 1
+    for name in ("repetitive", "adversarial"):
+        w = r["workloads"][name]
+        assert w["tokens_per_s_plain"] > 0.0
+        assert w["tokens_per_s_spec"] > 0.0
+        assert w["verify_dispatches"] > 0
+        assert 0 <= w["accepted"] <= w["drafted"]
+        # even a fully-rejected verify emits its bonus token, so the
+        # speculative path never amortizes below one token/dispatch
+        assert w["tokens_per_dispatch"] >= 1.0
+        assert 0.0 <= w["accept_rate"] <= 1.0
+    # the friendly workload must actually accept more than the
+    # adversarial one — otherwise "repetitive" is mislabeled
+    assert (r["workloads"]["repetitive"]["accept_rate"]
+            >= r["workloads"]["adversarial"]["accept_rate"])
+
+
 def test_obs_metrics_block():
     """The observability-tax block (ISSUE 6 satellite): per-update cost
     of each instrument kind, span enter/exit, and exposition latency at
@@ -355,4 +392,6 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     assert result["supervisor"]["ok"] is True
     assert result["elastic"]["ok"] is True
     assert result["serving"]["ok"] is True
+    assert result["serving_spec"]["ok"] is True
+    assert result["serving_spec"]["streams_identical"] is True
     assert result["obs"]["ok"] is True

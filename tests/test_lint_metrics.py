@@ -90,6 +90,19 @@ def test_lint_accepts_clean_registration():
         doc="apex_good_total apex_lat_seconds apex_depth") == []
 
 
+def test_lint_accepts_token_count_histograms():
+    """``_tokens`` is a real unit on the serving path (the speculative
+    acceptance-length histogram) — the lint accepts it alongside
+    ``_seconds``/``_bytes`` without loosening the no-unit rejection."""
+    assert _check_src(
+        'h = metrics.histogram("apex_accept_tokens", "token counts")\n',
+        doc="apex_accept_tokens") == []
+    problems = _check_src(
+        'h = metrics.histogram("apex_accept_count", "no unit")\n',
+        doc="apex_accept_count")
+    assert any("unit" in p for p in problems)
+
+
 def test_lint_ignores_non_literal_and_unrelated_calls():
     regs = check_metrics.collect_from_source(
         'x = registry.counter(name_var, "dynamic: out of scope")\n'

@@ -458,9 +458,18 @@ def test_bucket_padding_overhang_never_clobbers_cached_tokens(
     assert bool(jnp.all(logits == ref)), (
         "prefill near the cache end diverged — the padded tail write "
         "clobbered cached K/V")
-    # scheduler route: budget fragmentation lands a tiny tail at an
-    # unaligned offset (88 + bucket 8 > 90); the stream must still
-    # produce the uncached forward's greedy tokens
+
+
+@pytest.mark.slow
+def test_bucket_padding_overhang_scheduler_route(model, params, full_fwd):
+    """Scheduler route of the overhang claim: budget fragmentation
+    lands a tiny tail at an unaligned offset (88 + bucket 8 > 90); the
+    stream must still produce the uncached forward's greedy tokens.
+    Slow-tier (its own 3-bucket table at an off-size max_len is a fresh
+    compile set); the direct-engine overhang witness above stays
+    tier-1."""
+    small = 90
+    toks = _prompt(n=small)
     eng2 = sv.DecodeEngine(model, params, slots=1, max_len=small,
                            prefill_len=64, prefill_buckets=(8, 16, 64))
     sched = sv.ContinuousBatchingScheduler(eng2, log_interval=10 ** 9,
@@ -477,7 +486,27 @@ def test_bucket_padding_overhang_never_clobbers_cached_tokens(
 def test_chunk_split_never_changes_bits(model, params):
     """The same prompt through one-shot prefill vs manual uneven chunks
     yields the SAME logits bit-for-bit — chunk boundaries are an
-    implementation detail, not a numerics knob."""
+    implementation detail, not a numerics knob.  (Tier-1 witness at the
+    single-bucket size; the multi-bucket sweep is the slow-marked
+    variant below.)"""
+    toks = _prompt(n=16)
+    eng1 = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=16)
+    one = eng1.prefill(0, toks)
+    eng2 = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=16)
+    for lo, hi in ((0, 3), (3, 10), (10, 16)):
+        chunked = eng2.prefill_chunk(0, toks[lo:hi])
+    assert bool(jnp.all(one == chunked))
+    assert eng2.lengths()[0] == 16
+
+
+@pytest.mark.slow
+def test_chunk_split_never_changes_bits_multi_bucket(model, params):
+    """The uneven-manual-chunks equality sweep at the multi-bucket
+    config (prefill_len=64 — chunk lengths land in three different
+    buckets): compile-heavy, so slow-tier; the single-bucket tier-1
+    variant above keeps the claim family witnessed."""
     toks = _prompt(n=40)
     eng1 = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
                            prefill_len=64)
@@ -490,10 +519,15 @@ def test_chunk_split_never_changes_bits(model, params):
     assert eng2.lengths()[0] == 40
 
 
+@pytest.mark.slow
 def test_mixed_prompt_length_drain_bounded_compiles_fifo(model, params):
     """ISSUE-7 satellite: a mixed drain over lengths 1, 63, 64, 65,
     prefill_len and > prefill_len — bounded prefill compiles (the
-    bucket table), FIFO no-starvation, every stream completes."""
+    bucket table), FIFO no-starvation, every stream completes.
+    Slow-tier (a 5-entry bucket table is the compile-heaviest serving
+    config in the suite); FIFO drain and the compile bounds keep tier-1
+    witnesses in ``test_scheduler_drains_staggered_mixed_workload`` and
+    ``test_long_prompt_chunked_prefill_bit_identical``."""
     eng = sv.DecodeEngine(model, params, slots=2, max_len=MAX,
                           prefill_len=80,
                           prefill_buckets=(8, 16, 32, 64, 80))

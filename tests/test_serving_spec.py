@@ -1,0 +1,467 @@
+"""Speculative decoding: exact-greedy n-gram drafting + batched
+multi-token verification (ISSUE 9).
+
+THE acceptance run: a repetitive long prompt + >= 40 greedy tokens
+decoded with speculation enabled is **bit-identical** — exact f32
+logits at every emitted position and the identical token stream — to
+plain one-token decode, including across a mid-stream rejection +
+rollback and with a concurrent neighbor slot mid-chunked-prefill (the
+neighbor stays bit-isolated).  The mechanism: every verify row goes
+through the same masked fixed-``max_len``-extent attention as a
+single-token decode step, so "target argmax == drafted token" is an
+exact accept test and a rejected row is rolled back (length commit)
+before its garbage is ever readable.
+
+Plus: the scheduler path (spec on == spec off, token for token, in
+fewer steps), the non-greedy escape hatch (temperature>0 requests keep
+the existing path byte-for-byte: same tokens, same event/metric
+sequences, zero verify compiles), draft-bucket compile bounds, the
+adaptive-k policy, EOS truncation inside an accepted draft, and the
+prompt-lookup drafter itself.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import _logging
+from apex_tpu import serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+from apex_tpu.obs import bridge as obs_bridge
+from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
+
+# GQA on purpose, like test_serving.py: kv_heads (2) < heads (4)
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256)
+MAX = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+def _rep_prompt(n=30, seed=3):
+    """A repetitive prompt: an n-gram-matchable motif repeated."""
+    rng = np.random.default_rng(seed)
+    motif = [int(x) for x in rng.integers(0, CFG.vocab_size, 6)]
+    return (motif * ((n + 5) // 6))[:n]
+
+
+def _rand_prompt(n=8, seed=11):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(0, CFG.vocab_size, n)]
+
+
+def _mk_engine(model, params, prefill_len=16, slots=2):
+    return sv.DecodeEngine(model, params, slots=slots, max_len=MAX,
+                           prefill_len=prefill_len)
+
+
+@pytest.fixture(scope="module")
+def eng_pair(model, params):
+    """One warm (plain, spec) engine pair shared by every
+    scheduler-level test below: slots free after each drain, streams
+    are state-independent, and sharing keeps the file's compile bill
+    at one program set instead of one per test.  Tests that assert
+    *zero* verify compiles build their own fresh engines."""
+    return _mk_engine(model, params), _mk_engine(model, params)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: spec decode == plain decode, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def test_spec_decode_bit_identical_with_rejection_and_neighbor_prefill(
+        model, params):
+    """>= 40 greedy tokens via drafting + verification: identical token
+    stream AND bit-identical f32 logits at every emitted position vs
+    plain one-token decode — across a forced mid-stream rejection +
+    rollback, with a neighbor slot chunk-prefilling concurrently, and
+    with verify compiles bounded by the draft bucket table.  The
+    neighbor's own prefill logits are asserted bit-isolated too."""
+    prompt = _rep_prompt()
+    n_steps = 44
+
+    # -- plain reference: per-step logits + greedy stream
+    eng_ref = _mk_engine(model, params)
+    logits = eng_ref.prefill(0, list(prompt))
+    stream = [int(jnp.argmax(logits))]
+    plain_logits = []                  # plain_logits[i] follows stream[i]
+    for _ in range(n_steps):
+        l = eng_ref.decode(np.array([stream[-1], 0], np.int32),
+                           np.array([True, False]))[0]
+        plain_logits.append(np.asarray(l))
+        stream.append(int(jnp.argmax(l)))
+
+    # -- neighbor solo reference: chunked prefill of a long prompt on
+    # an independent engine (eng_ref's other slot is free and its
+    # programs are warm)
+    long_prompt = _rand_prompt(n=40, seed=9)
+    nref = np.asarray(eng_ref.prefill(1, long_prompt))
+
+    # -- speculative run: drafts from prompt lookup; one draft is
+    # deliberately corrupted to force a rejection + rollback mid-stream
+    eng = _mk_engine(model, params)
+    first = eng.prefill(0, list(prompt))
+    assert int(jnp.argmax(first)) == stream[0]
+    emitted = [stream[0]]
+    checked = 0                        # emitted positions logits-checked
+    n_verifies = 0
+    forced_rejection = False
+    neighbor_fed = 0
+    neighbor_logits = None
+    while len(emitted) - 1 < n_steps:
+        # interleave: one 16-token chunk of the neighbor's prompt
+        # between verifies (mid-chunked-prefill concurrency)
+        if neighbor_fed < len(long_prompt):
+            neighbor_logits = eng.prefill_chunk(
+                1, long_prompt[neighbor_fed:neighbor_fed + 16])
+            neighbor_fed += 16
+        history = list(prompt) + emitted
+        draft = propose(history, 4) or [emitted[-1]]   # any draft is exact
+        if n_verifies == 5 and not forced_rejection:
+            # corrupt the draft's first token: guaranteed rejection
+            draft = [(stream[len(emitted)] + 1) % CFG.vocab_size] \
+                + draft[1:]
+            forced_rejection = True
+        accepted, greedy, rows = eng.verify_draft(
+            0, [emitted[-1]] + draft)
+        n_verifies += 1
+        rows = np.asarray(rows)
+        step_tokens = list(draft[:accepted]) + [int(greedy[accepted])]
+        for i, tok in enumerate(step_tokens):
+            pos = len(emitted) - 1     # index into plain_logits
+            if pos >= n_steps:
+                break                  # past the recorded reference
+            assert np.array_equal(rows[i], plain_logits[pos]), (
+                f"spec logits diverged from plain decode at emitted "
+                f"position {pos}")
+            checked += 1
+            emitted.append(tok)
+    assert emitted == stream[:len(emitted)], "token stream diverged"
+    assert len(emitted) - 1 >= 40 and checked >= 40
+    assert forced_rejection, "the forced rejection never fired"
+    # rejections happened and were survived (the forced one at least)
+    assert eng.verify_compiles() <= len(eng.draft_buckets)
+    assert eng.decode_compiles() == 0      # pure-verify decode phase
+    # neighbor stayed bit-isolated through interleaved spec verifies
+    assert neighbor_fed >= len(long_prompt)
+    assert np.array_equal(np.asarray(neighbor_logits), nref), (
+        "neighbor chunked prefill diverged next to speculative decode")
+
+
+def test_verify_rejection_rolls_back_exactly(model, params):
+    """A fully-rejected draft must leave the slot exactly one plain
+    decode step ahead: same pending token, same length, and the next
+    verify still produces bit-identical logits (the rolled-back rows
+    are unreadable)."""
+    prompt = _rand_prompt()
+    eng_ref = _mk_engine(model, params)
+    logits = eng_ref.prefill(0, list(prompt))
+    stream = [int(jnp.argmax(logits))]
+    plain = []
+    for _ in range(4):
+        l = eng_ref.decode(np.array([stream[-1], 0], np.int32),
+                           np.array([True, False]))[0]
+        plain.append(np.asarray(l))
+        stream.append(int(jnp.argmax(l)))
+
+    eng = _mk_engine(model, params)
+    eng.prefill(0, list(prompt))
+    wrong = [(stream[1] + 1) % CFG.vocab_size,
+             (stream[2] + 1) % CFG.vocab_size]
+    accepted, greedy, rows = eng.verify_draft(0, [stream[0]] + wrong)
+    assert accepted == 0
+    assert int(greedy[0]) == stream[1]          # the bonus IS the truth
+    assert np.array_equal(np.asarray(rows)[0], plain[0])
+    assert eng.lengths()[0] == len(prompt) + 1  # rolled back to +1
+    # chain another verify after the rollback: still bit-exact
+    accepted2, greedy2, rows2 = eng.verify_draft(
+        0, [stream[1], stream[2], stream[3]])
+    assert accepted2 == 2
+    assert np.array_equal(np.asarray(rows2)[1], plain[2])
+    assert [int(greedy2[i]) for i in (0, 1, 2)] == stream[2:5]
+
+
+def test_verify_draft_guards(model, params):
+    eng = _mk_engine(model, params)
+    with pytest.raises(ValueError):        # never prefilled
+        eng.verify_draft(0, [1, 2])
+    eng.prefill(0, [1, 2, 3])
+    with pytest.raises(ValueError):        # no draft to verify
+        eng.verify_draft(0, [1])
+    with pytest.raises(ValueError):        # past max_draft
+        eng.verify_draft(0, [1] * (eng.max_draft + 2))
+    with pytest.raises(ValueError):        # slot out of range
+        eng.verify_draft(9, [1, 2])
+    small = sv.DecodeEngine(model, params, slots=1, max_len=8,
+                            prefill_len=8, draft_buckets=(1, 4))
+    small.prefill(0, [1] * 6)
+    with pytest.raises(ValueError):        # 6 + 4 real tokens > 8
+        small.verify_draft(0, [1, 2, 3, 4])
+    with pytest.raises(ValueError):        # buckets must fit the cache
+        sv.DecodeEngine(model, params, slots=1, max_len=8,
+                        prefill_len=8, draft_buckets=(8,))
+    with pytest.raises(ValueError):        # not ascending
+        sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                        prefill_len=8, draft_buckets=(4, 2))
+    with pytest.raises(ValueError):        # 0-length draft bucket
+        sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                        prefill_len=8, draft_buckets=(0, 2))
+    assert sv.default_draft_buckets(8) == (1, 2, 4, 8)
+    assert sv.default_draft_buckets(6) == (1, 2, 4, 6)
+    assert sv.default_draft_buckets(1) == (1,)
+    assert eng.draft_bucket_for(3) == 4
+    with pytest.raises(ValueError):
+        eng.draft_bucket_for(0)
+
+
+# ---------------------------------------------------------------------------
+# scheduler path: identical streams, fewer steps, adaptive drafting
+# ---------------------------------------------------------------------------
+
+
+def _run_sched(eng, *, speculation, requests):
+    sched = sv.ContinuousBatchingScheduler(eng, log_interval=10 ** 9,
+                                           speculation=speculation)
+    for r in requests:
+        sched.submit(r)
+    results = sched.run()
+    return results, sched, eng
+
+
+def test_scheduler_spec_streams_identical_in_fewer_steps(eng_pair):
+    reqs = lambda: [                                   # noqa: E731
+        sv.Request("greedy_rep", _rep_prompt(), max_new_tokens=40),
+        sv.Request("greedy_rand", _rand_prompt(), max_new_tokens=12),
+        sv.Request("sampled", _rand_prompt(seed=5), max_new_tokens=8,
+                   temperature=0.7, top_k=8, seed=13),
+    ]
+    plain, s_plain, e_plain = _run_sched(eng_pair[0], speculation=None,
+                                         requests=reqs())
+    spec, s_spec, e_spec = _run_sched(eng_pair[1],
+                                      speculation=SpeculationConfig(),
+                                      requests=reqs())
+    for rid in ("greedy_rep", "greedy_rand", "sampled"):
+        assert spec[rid].tokens == plain[rid].tokens, rid
+        assert spec[rid].finish_reason == plain[rid].finish_reason
+    # the repetitive stream accepted drafts, so the drain took fewer
+    # shared steps than one-token-per-step decode
+    assert s_spec.steps_run < s_plain.steps_run
+    stats = s_spec.spec_stats
+    assert stats["dispatches"] > 0
+    assert stats["emitted"] >= stats["dispatches"]     # >= 1 token each
+    assert stats["accepted"] <= stats["drafted"]
+    assert e_spec.verify_compiles() <= len(e_spec.draft_buckets)
+    assert e_spec.decode_compiles() == 1   # fall-back lanes still shared
+    assert e_plain.verify_compiles() == 0
+
+
+def test_eos_inside_accepted_draft_truncates_like_plain(eng_pair):
+    """An EOS token emitted mid-verify must end the stream exactly
+    where plain decode would have stopped — later accepted tokens are
+    discarded, not emitted."""
+    prompt = _rep_prompt()
+    plain, _, _ = _run_sched(
+        eng_pair[0], speculation=None,
+        requests=[sv.Request("probe", prompt, max_new_tokens=40)])
+    # pick an EOS that plain decode emits somewhere past the first token
+    eos = plain["probe"].tokens[6]
+    mk = lambda: [sv.Request("r", prompt, max_new_tokens=40,    # noqa: E731
+                             eos_id=eos)]
+    a, _, _ = _run_sched(eng_pair[0], speculation=None, requests=mk())
+    b, sched_b, _ = _run_sched(eng_pair[1],
+                               speculation=SpeculationConfig(),
+                               requests=mk())
+    assert a["r"].tokens == b["r"].tokens
+    assert a["r"].finish_reason == b["r"].finish_reason == "eos"
+    assert len(b["r"].tokens) <= 7
+
+
+def test_spec_respects_max_new_tokens_exactly(eng_pair):
+    for n in (1, 2, 5, 17):
+        plain, _, _ = _run_sched(
+            eng_pair[0], speculation=None,
+            requests=[sv.Request(f"p{n}", _rep_prompt(),
+                                 max_new_tokens=n)])
+        spec, _, _ = _run_sched(
+            eng_pair[1], speculation=SpeculationConfig(),
+            requests=[sv.Request(f"s{n}", _rep_prompt(),
+                                 max_new_tokens=n)])
+        assert spec[f"s{n}"].tokens == plain[f"p{n}"].tokens
+        assert len(spec[f"s{n}"].tokens) == n
+
+
+# ---------------------------------------------------------------------------
+# the non-greedy escape hatch: byte-for-byte bypass (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+# wall-clock-derived event fields: the only payload allowed to differ
+# between a speculation-enabled and -disabled run of a sampled request
+_TIMING_FIELDS = ("ttft_s", "duration_s", "tokens_per_s", "per_token_ms",
+                  "time", "t_wall")
+
+
+def _capture_run(model, params, speculation):
+    events = []
+
+    def sink(event):
+        events.append({k: v for k, v in event.items()
+                       if k not in _TIMING_FIELDS})
+
+    spec_metrics_before = (
+        obs_bridge.SERVING_SPEC_DRAFTED.value(),
+        obs_bridge.SERVING_SPEC_ACCEPTED.value(),
+        obs_bridge.SERVING_SPEC_REJECTED.value(),
+    )
+    _logging.add_event_sink(sink)
+    try:
+        # fresh engine on purpose: the bypass must leave it with ZERO
+        # verify compiles, which a shared warm engine cannot witness
+        results, sched, eng = _run_sched(
+            _mk_engine(model, params), speculation=speculation,
+            requests=[sv.Request("r", _rep_prompt(), max_new_tokens=12,
+                                 temperature=0.9, top_k=8, seed=21),
+                      sv.Request("s", _rand_prompt(), max_new_tokens=6,
+                                 temperature=1.3, seed=4)])
+    finally:
+        _logging.remove_event_sink(sink)
+    spec_metrics_delta = tuple(
+        after - before for after, before in zip((
+            obs_bridge.SERVING_SPEC_DRAFTED.value(),
+            obs_bridge.SERVING_SPEC_ACCEPTED.value(),
+            obs_bridge.SERVING_SPEC_REJECTED.value(),
+        ), spec_metrics_before))
+    return results, events, spec_metrics_delta, sched, eng
+
+
+def test_temperature_requests_bypass_speculation_byte_for_byte(
+        model, params):
+    """Fixed-seed temperature>0 requests with speculation ENABLED must
+    produce byte-identical token streams AND identical event/metric
+    sequences as with speculation disabled: drafting silently bypassed,
+    no verify compiles triggered, no speculation metrics touched."""
+    off = _capture_run(model, params, None)
+    on = _capture_run(model, params, SpeculationConfig())
+    for rid in ("r", "s"):
+        assert on[0][rid].tokens == off[0][rid].tokens
+    # identical event sequences (kinds AND non-timing payloads)
+    assert on[1] == off[1]
+    assert not any(e.get("event") == "serving_spec_verify"
+                   for e in on[1])
+    # no speculation metric moved in either run
+    assert on[2] == off[2] == (0.0, 0.0, 0.0)
+    # no verify program was ever compiled, and the spec accounting
+    # stayed untouched — the bypass is structural, not cosmetic
+    assert on[4].verify_compiles() == 0
+    assert on[3].spec_stats == {"dispatches": 0, "drafted": 0,
+                                "accepted": 0, "emitted": 0}
+
+
+def test_spec_verify_events_feed_metrics(eng_pair):
+    """Greedy speculation emits serving_spec_verify events and the
+    bridge turns them into the drafted/accepted/rejected counters, the
+    acceptance-length histogram, and the speedup gauge."""
+    drafted0 = obs_bridge.SERVING_SPEC_DRAFTED.value()
+    accepted0 = obs_bridge.SERVING_SPEC_ACCEPTED.value()
+    rejected0 = obs_bridge.SERVING_SPEC_REJECTED.value()
+    hist0 = obs_bridge.SERVING_SPEC_ACCEPT_LENGTH.count()
+    events = []
+    _logging.add_event_sink(events.append)
+    try:
+        _, sched, eng = _run_sched(
+            eng_pair[1], speculation=SpeculationConfig(),
+            requests=[sv.Request("metrics_r", _rep_prompt(),
+                                 max_new_tokens=24)])
+    finally:
+        _logging.remove_event_sink(events.append)
+    stats = sched.spec_stats
+    assert stats["dispatches"] > 0
+    verifies = [e for e in events
+                if e.get("event") == "serving_spec_verify"]
+    assert len(verifies) == stats["dispatches"]
+    for e in verifies:
+        assert 0 <= e["accepted"] <= e["drafted"]
+        assert e["bucket"] in eng.draft_buckets
+        assert e["emitted"] >= 1
+    assert (obs_bridge.SERVING_SPEC_DRAFTED.value() - drafted0
+            == stats["drafted"])
+    assert (obs_bridge.SERVING_SPEC_ACCEPTED.value() - accepted0
+            == stats["accepted"])
+    assert (obs_bridge.SERVING_SPEC_REJECTED.value() - rejected0
+            == stats["drafted"] - stats["accepted"])
+    assert (obs_bridge.SERVING_SPEC_ACCEPT_LENGTH.count() - hist0
+            == stats["dispatches"])
+    assert obs_bridge.SERVING_SPEC_SPEEDUP.value() == pytest.approx(
+        stats["emitted"] / stats["dispatches"])
+
+
+# ---------------------------------------------------------------------------
+# the drafter and the adaptive-k policy (pure host logic)
+# ---------------------------------------------------------------------------
+
+
+def test_prompt_lookup_proposes_continuations():
+    # longest suffix [2, 3] matched earlier -> continuation [4, 1, 2]
+    assert propose([1, 2, 3, 4, 1, 2, 3], 3) == [4, 1, 2]
+    # k caps the draft
+    assert propose([1, 2, 3, 4, 1, 2, 3], 1) == [4]
+    # a longer suffix match wins over a shorter one
+    h = [7, 1, 2, 3, 9, 1, 2, 3, 5, 1, 2, 3]
+    assert propose(h, 2)[:1] == [5]       # matches [1,2,3] at pos 5
+    # most RECENT earlier occurrence wins within a suffix length
+    assert propose([1, 2, 8, 1, 2, 9, 1, 2], 1) == [9]
+    # ...but an occurrence too close to the end to carry a full draft
+    # yields to an older one that can (the periodic-tail case)
+    assert propose([9] * 10, 2) == [9, 9]
+    assert propose([5, 6, 5, 6, 5, 6, 5, 6], 3) == [5, 6, 5]
+    # a lone occurrence with a short continuation still drafts it
+    assert propose([9, 9, 9, 9], 2) == [9]
+    # no match -> empty (the fall-back signal)
+    assert propose([1, 2, 3, 4, 5], 3) == []
+    # degenerate inputs
+    assert propose([], 3) == []
+    assert propose([1], 3) == []
+    assert propose([1, 2, 3], 0) == []
+
+
+def test_adaptive_k_policy():
+    cfg = SpeculationConfig(max_draft=8, min_draft=1)
+    assert adapt_k(4, 4, 4, cfg) == 8      # full accept: double
+    assert adapt_k(8, 8, 8, cfg) == 8      # capped at max
+    assert adapt_k(2, 2, 2, cfg) == 4
+    assert adapt_k(8, 8, 7, cfg) == 4      # any rejection: halve
+    assert adapt_k(2, 2, 0, cfg) == 1
+    assert adapt_k(1, 1, 0, cfg) == 1      # floored at min
+    # a short (history-limited) draft fully accepted still grows
+    assert adapt_k(4, 2, 2, cfg) == 8
+    fixed = SpeculationConfig(max_draft=6, adaptive=False)
+    assert adapt_k(3, 6, 0, fixed) == 6    # pinned
+    with pytest.raises(ValueError):
+        SpeculationConfig(max_draft=0)
+    with pytest.raises(ValueError):
+        SpeculationConfig(min_draft=4, max_draft=2)
+    with pytest.raises(ValueError):
+        SpeculationConfig(ngram_min=0)
+    with pytest.raises(ValueError):
+        SpeculationConfig(ngram_max=1, ngram_min=2)
+
+
+def test_scheduler_rejects_overwide_speculation_config(model, params):
+    eng = sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                          prefill_len=8, draft_buckets=(1, 2, 4))
+    with pytest.raises(ValueError):
+        sv.ContinuousBatchingScheduler(
+            eng, speculation=SpeculationConfig(max_draft=8))
+    # a config the table covers is fine
+    sv.ContinuousBatchingScheduler(
+        eng, speculation=SpeculationConfig(max_draft=4))

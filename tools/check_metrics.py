@@ -11,8 +11,8 @@ alert on what they can look up).  This lint pins all three statically:
 1. every literal metric name passed to a ``counter(`` / ``gauge(`` /
    ``histogram(`` call under ``apex_tpu/`` matches ``^apex_[a-z0-9_]+$``;
 2. counters end in ``_total`` and histograms carry a unit suffix
-   (``_seconds`` / ``_bytes``) — the Prometheus conventions the docs
-   promise;
+   (``_seconds`` / ``_bytes`` / ``_tokens``) — the Prometheus
+   conventions the docs promise;
 3. each name is registered at exactly ONE call site (declare the
    instrument once at module level, import the object everywhere else);
 4. each name appears in ``docs/api/observability.md`` (regenerate via
@@ -38,7 +38,10 @@ DOC = os.path.join(REPO, "docs", "api", "observability.md")
 
 _METRIC_FUNCS = {"counter", "gauge", "histogram"}
 _NAME_RE = re.compile(r"^apex_[a-z0-9_]+$")
-_UNIT_SUFFIXES = ("_seconds", "_bytes")
+# _tokens joined for the speculative-decode acceptance-length
+# histogram: token counts are a real unit on the serving path, and a
+# forced _seconds name would lie about what the samples measure
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_tokens")
 
 
 class Registration(NamedTuple):

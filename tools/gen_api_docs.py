@@ -90,8 +90,8 @@ PAGES = {
     ]),
     "serving": ("Serving (KV-cached decode + continuous batching)", [
         "apex_tpu.serving", "apex_tpu.serving.kv_cache",
-        "apex_tpu.serving.engine", "apex_tpu.serving.scheduler",
-        "apex_tpu.serving.weights",
+        "apex_tpu.serving.engine", "apex_tpu.serving.draft",
+        "apex_tpu.serving.scheduler", "apex_tpu.serving.weights",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
         "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
@@ -500,6 +500,60 @@ only ever sees the compiled programs, and the decode step compiles
 no per-request retraces, the recompile tax the slotted cache exists to
 eliminate).
 
+## Speculative decoding (exact-greedy prompt lookup)
+
+Plain decode pays one full weight read and one full-`max_len`-extent
+cache read **per token per step** — the dominant cost of the decode
+phase.  `ContinuousBatchingScheduler(...,
+speculation=SpeculationConfig(...))` amortizes that dispatch over
+several tokens without changing a single emitted bit:
+
+- **Drafting** (`serving.draft.propose`) is *prompt lookup*: the
+  longest suffix (n-gram, `ngram_max` down to `ngram_min`) of the
+  request's own prompt + generated history that re-occurred earlier
+  predicts its continuation — up to k candidate tokens, purely host
+  side, no draft model, zero device cost.  No match → empty proposal →
+  the slot simply rides the plain batched decode step that round.
+- **Verification** (`DecodeEngine.verify_draft`) scores the slot's
+  pending token plus all k candidates in ONE cached multi-token
+  forward — the chunked-prefill machinery, but keeping every row's
+  logits instead of slicing the last.  Row i is **bit-identical** to
+  the single-token decode logits at that depth (same masked
+  fixed-extent reductions), so "does the target's argmax equal the
+  drafted token" is an exact test, not a heuristic.  Acceptance and
+  rollback run inside the same dispatch: the slot's length commits to
+  `offset + accepted + 1`, which makes every rejected row's K/V
+  unreadable (the same O(1) length move as eviction) — the emitted
+  stream `draft[:accepted] + [bonus]` is exactly what `accepted + 1`
+  plain decode steps would have produced, bit for bit, including
+  across mid-stream rejections (tier-1:
+  `tests/test_serving_spec.py`).
+- **Bounded compiles**: drafts are padded to a small power-of-two
+  `draft_buckets` table (`default_draft_buckets`; verify width =
+  bucket + 1), so `verify_compiles() <= len(draft_buckets)` — the same
+  asserted budget discipline as the prefill buckets.  The decode step
+  still compiles exactly once; an engine that never verifies never
+  compiles a verify program.
+- **Adaptive draft length** (`serving.draft.adapt_k`): full acceptance
+  doubles the next draft (up to `max_draft`), any rejection halves it
+  (down to `min_draft`) — per request, deterministic, so
+  incompressible streams stop paying for wide verifies within a couple
+  of steps.  A rejected verify still emits one true token (the bonus
+  row *is* the plain decode output), so the speculative path never
+  emits fewer tokens per dispatch than plain decode.
+- **The escape hatch is byte-for-byte**: sampled (`temperature > 0`)
+  requests never enter the drafting path — same token stream, same
+  event and metric sequence, zero verify compiles, with speculation
+  enabled or disabled (tier-1 pins the equality).
+
+Honest accounting: a verify of width w costs ~w× the projections/MLP
+FLOPs of a decode step plus the same fixed-extent attention read, so
+the win is `(accepted + 1)` tokens per dispatch *minus* that wider
+dispatch — large when traffic is repetitive (summarization, code edit,
+RAG with quoted context, self-repeating generations), ≈ 1.0x when the
+drafter never matches (the adversarial bar `bench.py serving_spec`
+records).
+
 ## Determinism guarantees
 
 - **Prefill and greedy decode are bit-identical to the uncached
@@ -508,6 +562,11 @@ eliminate).
   every step's f32 logits exactly equal to the shape-stable uncached
   forward (context padded to `max_len`), and the greedy stream
   identical to the unpadded forward.
+- **Speculation is scheduling, not numerics**: greedy decode with
+  drafting + multi-token verification emits the identical token stream
+  — and identical f32 logits at every emitted position — as plain
+  one-token decode, including across rejections/rollbacks and with
+  neighbor slots mid-chunked-prefill (tier-1 pins the 40+-token run).
 - **Chunk splits are invisible**: the same prompt through one-shot
   prefill, even chunks, or uneven manual chunks yields the same logits
   bit-for-bit.
@@ -527,7 +586,10 @@ Structured `emit_event` lines ride the `apex_tpu.events` logger:
 `serving_request_queued` / `serving_request_admitted` (queue depth),
 `serving_prefill_chunk` (bucket size, chunk tokens, dispatch wall
 time — feeding the `apex_serving_prefill_duration_seconds{bucket}`
-histogram), `serving_first_token` (TTFT), `serving_request_finished`
+histogram), `serving_spec_verify` (drafted/accepted counts + dispatch
+wall time — feeding the speculation counters and the
+`apex_serving_spec_accepted_tokens` acceptance-length histogram),
+`serving_first_token` (TTFT), `serving_request_finished`
 (tokens/s, per-token latency, finish reason), and a periodic
 `serving_step` sample (queue depth, active slots, prefill backlog).
 `bench.py` captures a `serving` block — prefill tokens/s, steady-state
@@ -536,7 +598,12 @@ concurrent streams with staggered arrivals (4 concurrent streams ≥ 2×
 four sequential runs), and a mixed-prompt-length workload where
 bucketed chunked prefill must beat the padded single-program baseline
 by ≥ 1.5× with `prefill_compiles` ≤ the bucket count and
-`decode_compiles == 1` (the compile-count regression guard).
+`decode_compiles == 1` (the compile-count regression guard) — and a
+`serving_spec` block: best-of-N spec-vs-plain greedy decode tokens/s
+on an acceptance-friendly repetitive workload (bar ≥ 1.8×) and on an
+adversarial random-token workload (bar ≥ 1.0× — no regression), with
+`verify_compiles` bounded by the draft bucket table and
+`decode_compiles == 1` preserved.
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -554,7 +621,7 @@ Enforced at registration (`obs.metrics`) **and** statically by
 
 - every name matches `^apex_[a-z0-9_]+$`;
 - counters end in `_total`; histograms carry a unit suffix
-  (`_seconds` / `_bytes`); gauges are free-form;
+  (`_seconds` / `_bytes` / `_tokens`); gauges are free-form;
 - each name is registered at exactly **one** call site (declare the
   instrument once at module level, import the object everywhere else);
 - each name appears in the inventory below (the lint cross-checks this
@@ -594,6 +661,11 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_cache_utilization` | gauge | `DecodeEngine.cache_utilization()`, every step |
 | `apex_serving_decode_compiles` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
 | `apex_serving_prefill_backlog` | gauge | scheduler, every step (prompt tokens deferred by the prefill budget) |
+| `apex_serving_spec_drafted_total` | counter | `serving_spec_verify` events (draft tokens proposed by prompt lookup) |
+| `apex_serving_spec_accepted_total` | counter | `serving_spec_verify` events (drafted tokens the verify argmax accepted) |
+| `apex_serving_spec_rejected_total` | counter | `serving_spec_verify` events (drafted − accepted; rolled back, never emitted) |
+| `apex_serving_spec_accepted_tokens` | histogram | `serving_spec_verify` events (accepted draft length per verify; token-count buckets) |
+| `apex_serving_spec_speedup` | gauge | scheduler, per step once a verify has run (tokens emitted per verify dispatch; 1.0 == plain decode) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -921,6 +993,39 @@ how requests arrive.  Prefill — one-shot, bucketed, or chunked past
 to the uncached forward (the tier-1 acceptance tests), sampling replays
 exactly from its explicit seeds, and deferred admission work is visible
 as the `apex_serving_prefill_backlog` gauge.
+
+Speed up decode with speculation — plain decode reads every weight once
+per token; when the output repeats content the stream has already seen
+(summarization, code edit, RAG quoting its context), prompt-lookup
+speculative decoding amortizes that read over several tokens **without
+changing a single emitted bit**: a host-side n-gram match over the
+request's own history drafts up to k tokens (no draft model, zero
+device cost), one bucketed multi-token *verify* dispatch scores all
+k+1 positions through the chunked-prefill machinery, and the longest
+draft prefix the target's own greedy argmax agrees with is emitted
+plus a free bonus token ([full page](api/serving.md)):
+
+```python
+sched = sv.ContinuousBatchingScheduler(
+    eng, max_queue=64,
+    speculation=sv.SpeculationConfig(
+        max_draft=8,         # widest draft (verify compiles stay
+                             # bounded by the engine's draft_buckets)
+        ngram_max=4))        # longest suffix the lookup tries
+sched.submit(sv.Request("r0", prompt_ids, max_new_tokens=128, eos_id=2))
+results = sched.run()        # bit-identical tokens, fewer dispatches
+```
+
+Greedy requests adapt their draft length to the measured acceptance
+(double on full accept, halve on rejection); streams with no n-gram
+match and all `temperature > 0` requests ride the existing decode path
+— the latter byte-for-byte (no drafting, no verify compiles, identical
+events and metrics).  Acceptance telemetry rides
+`apex_serving_spec_{drafted,accepted,rejected}_total`, the
+`apex_serving_spec_accepted_tokens` histogram, and the
+`apex_serving_spec_speedup` gauge (tokens emitted per verify
+dispatch); `bench.py`'s `serving_spec` block records the honest
+speedup on both a repetitive and an adversarial workload.
 
 Watch a training job live — the supervisor, checkpoint manager, and
 serving scheduler already publish into the default metrics registry

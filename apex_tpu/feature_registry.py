@@ -72,5 +72,8 @@ register("supervisor", "step watchdog + heartbeat + transient retry + data guard
 register("serving", "slotted KV-cache decode + continuous batching + "
          "exact-greedy speculative decoding + checkpoint serving",
          False, "jnp/XLA + host scheduler")
+register("prefix_cache", "cross-request prefix caching: chain-hashed shared-prompt "
+         "K/V reuse with bit-exact mid-prompt prefill resume",
+         False, "jnp/XLA + host block store")
 register("obs", "metrics registry + span tracing + Prometheus/Chrome-trace exporters",
          False, "host-side stdlib")

@@ -23,7 +23,8 @@ idempotent.  The default log sink is untouched: ``emit_event`` output
 stays byte-identical with or without the bridge.
 
 Serving **gauges** (queue depth, slot occupancy, cache utilization,
-prefill backlog, decode compiles, speculation speedup) are declared
+prefill backlog, decode compiles, speculation speedup, prefix-cache
+cached tokens) are declared
 here but *set directly* by the scheduler each step — a gauge describes current state, and
 routing it through the event stream would tie its freshness to
 ``log_interval``.  Pipeline timers publish through
@@ -94,6 +95,23 @@ SERVING_PREFILL_BACKLOG = metrics.gauge(
     "apex_serving_prefill_backlog",
     "prompt tokens admitted or queued but not yet cached (deferred by "
     "the per-step prefill budget)")
+SERVING_PREFIX_HITS = metrics.counter(
+    "apex_serving_prefix_hit_total",
+    "admissions that restored a cached prompt prefix (prefill resumed "
+    "mid-prompt, bit-identically)")
+SERVING_PREFIX_MISSES = metrics.counter(
+    "apex_serving_prefix_miss_total",
+    "admissions with no cached prefix to reuse (full prefill)")
+SERVING_PREFIX_SAVED = metrics.histogram(
+    "apex_serving_prefix_saved_tokens",
+    "prompt tokens restored from the prefix cache per hit — prefill "
+    "work not re-run (block-granular, so the floor is one block)",
+    buckets=tuple(float(b) for b in (16, 32, 64, 128, 256, 512, 1024,
+                                     2048, 4096, 8192)))
+SERVING_PREFIX_CACHED_TOKENS = metrics.gauge(
+    "apex_serving_prefix_cached_tokens",
+    "tokens of K/V held by the cross-request prefix cache (refreshed "
+    "per scheduler step while prefix caching is enabled)")
 SERVING_SPEC_DRAFTED = metrics.counter(
     "apex_serving_spec_drafted_total",
     "draft tokens proposed by prompt lookup (speculative decode)")
@@ -189,6 +207,17 @@ def _on_serving_spec_verify(event: dict) -> None:
     SERVING_SPEC_ACCEPT_LENGTH.observe(accepted)
 
 
+def _on_serving_prefix_hit(event: dict) -> None:
+    SERVING_PREFIX_HITS.inc()
+    saved = _measurement(event, "saved_tokens")
+    if saved is not None:
+        SERVING_PREFIX_SAVED.observe(saved)
+
+
+def _on_serving_prefix_miss(event: dict) -> None:
+    SERVING_PREFIX_MISSES.inc()
+
+
 def _on_serving_request_finished(event: dict) -> None:
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
@@ -209,6 +238,8 @@ _HANDLERS = {
     "checkpoint_rejected": _on_checkpoint_rejected,
     "serving_first_token": _on_serving_first_token,
     "serving_prefill_chunk": _on_serving_prefill_chunk,
+    "serving_prefix_hit": _on_serving_prefix_hit,
+    "serving_prefix_miss": _on_serving_prefix_miss,
     "serving_spec_verify": _on_serving_spec_verify,
     "serving_request_finished": _on_serving_request_finished,
 }

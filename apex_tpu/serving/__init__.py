@@ -30,6 +30,16 @@ head matmul), its amp policies, and its resilience checkpoints:
   greedy argmax agrees with — the emitted stream is bit-identical to
   plain one-token decode by construction, and the per-request draft
   length adapts to the measured acceptance.
+- :mod:`.prefix_cache` — **cross-request prefix caching**: prompts are
+  hashed as a chain of fixed-size token blocks, each entry holding the
+  captured per-layer K/V for its span as owned device arrays; at
+  admission the scheduler restores the longest cached chain into the
+  fresh slot (``DecodeEngine.restore_prefix``) and spends prefill only
+  on the uncovered suffix — bit-identical to a cold admission, because
+  the restored bytes ARE what prefill would have written.  LRU
+  eviction under a token budget, ref-count pinning for entries feeding
+  live slots, insert-on-miss capture.  Opt-in
+  (``prefix_caching=PrefixCacheConfig(...)``), default off.
 - :mod:`.scheduler` — :class:`ContinuousBatchingScheduler`: bounded
   FIFO queue, slot admission at step boundaries, a per-step
   ``prefill_budget`` (in tokens) that interleaves prompt chunks with
@@ -74,9 +84,12 @@ from apex_tpu.serving.kv_cache import (
     append_token,
     init_cache,
     prefill_into_slot,
+    read_slot_region,
     release_slot,
     valid_token_mask,
+    write_slot_region,
 )
+from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from apex_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
     QueueFull,
@@ -91,8 +104,12 @@ __all__ = [
     "append_token",
     "init_cache",
     "prefill_into_slot",
+    "read_slot_region",
     "release_slot",
     "valid_token_mask",
+    "write_slot_region",
+    "PrefixCache",
+    "PrefixCacheConfig",
     "DecodeEngine",
     "SpeculationConfig",
     "adapt_k",

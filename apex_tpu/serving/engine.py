@@ -11,11 +11,18 @@ a pending token plus up to ``max_draft`` drafted candidates in one
 cached multi-token forward — see :meth:`DecodeEngine.verify_draft`) —
 all shape-stable by construction: chunks and drafts are padded to the
 smallest covering bucket, decode always runs all ``slots`` lanes, and
-the cache is preallocated (:mod:`apex_tpu.serving.kv_cache`).  After
-warmup the decode jit cache holds exactly one entry and the prefill /
-verify jit caches at most one entry per bucket, no matter how requests
-arrive (`tests/test_serving.py` / `tests/test_serving_spec.py` assert
-all three through :func:`apex_tpu.utils.compat.compile_count`).
+the cache is preallocated (:mod:`apex_tpu.serving.kv_cache`).  The
+cross-request prefix cache adds two more bounded families: a
+**prefix restore** program per prefill bucket (previously captured K/V
+written back verbatim — :meth:`DecodeEngine.restore_prefix`) and a
+fixed-extent **region read** for block capture
+(:meth:`DecodeEngine.read_region`; one compile per span extent,
+bounded by the blocks-per-chunk count).  After warmup the decode jit cache
+holds exactly one entry and the prefill / verify / restore jit caches
+at most one entry per bucket, no matter how requests arrive
+(`tests/test_serving.py` / `tests/test_serving_spec.py` /
+`tests/test_serving_prefix.py` assert them through
+:func:`apex_tpu.utils.compat.compile_count`).
 
 Prompts longer than ``prefill_len`` are served by **chunked cached
 prefill**: the prompt is split into ``prefill_len``-sized chunks (tail
@@ -62,6 +69,7 @@ from apex_tpu.serving.kv_cache import (
     commit_slot_length,
     init_cache,
     release_slot,
+    write_slot_region,
 )
 from apex_tpu.utils.compat import compile_count
 
@@ -247,6 +255,13 @@ class DecodeEngine:
             init_cache(model.config, slots=slots, max_len=max_len,
                        dtype=cache_dtype),
             jax.local_devices()[0])
+        # slots whose K/V arrived via restore_prefix (slot -> restored
+        # token count): the ONLY slots prefill() accepts a nonzero
+        # resume offset for — an arbitrary occupied slot is still
+        # rejected loudly (the PR-4 clobber guard), but a slot the
+        # engine itself verified and restored may legitimately resume
+        # mid-prompt
+        self._restored: dict[int, int] = {}
         # host mirror of per-slot lengths: lets every call validate slot
         # bounds and cache capacity WITHOUT a device->host sync on the
         # decode hot path (dynamic_update_slice clamps out-of-range
@@ -306,12 +321,38 @@ class DecodeEngine:
             cache = commit_slot_length(cache, slot, offset + accepted + 1)
             return greedy, rows, accepted.astype(jnp.int32), cache
 
+        def _restore(cache, k_blk, v_blk, slot, start, length):
+            # k_blk / v_blk [layers, B, kvh, hd] (one restore bucket's
+            # shape — compiles are bounded by the prefill bucket table,
+            # never per prefix length); start = rows already restored,
+            # length = REAL rows in this chunk (padding rows past it
+            # land beyond the committed length: masked garbage, exactly
+            # like a prefill chunk's bucket padding, and any overhang
+            # past max_len is dropped by the per-row scatter)
+            cache = write_slot_region(cache, slot, start, k_blk, v_blk)
+            return commit_slot_length(cache, slot, start + length)
+
+        def _read(cache, slot, start, *, n):
+            # the traced-start twin of kv_cache.read_slot_region (same
+            # row gather; the module primitive takes host ints while a
+            # capture wants ONE compiled program for every block offset
+            # — static extent, traced start)
+            rows = jnp.asarray(start, jnp.int32) + jnp.arange(
+                n, dtype=jnp.int32)
+            s = jnp.asarray(slot, jnp.int32)
+            return cache.k[:, s, rows], cache.v[:, s, rows]
+
         # the cache argument is donated: the engine discards the old
         # functional copy on every call, and without aliasing each
         # one-token step would copy the whole preallocated k/v pair
         self._prefill = jax.jit(_prefill, donate_argnums=(1,))
         self._decode = jax.jit(_decode, donate_argnums=(1,))
         self._verify = jax.jit(_verify, donate_argnums=(1,))
+        self._restore = jax.jit(_restore, donate_argnums=(0,))
+        # NOT donated: a region read must leave the cache intact, and
+        # its outputs are fresh owned buffers the prefix cache keeps
+        # alive across later (donating) engine calls
+        self._read = jax.jit(_read, static_argnames=("n",))
         logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
                      "buckets=%s cache_dtype=%s", self.slots,
                      self.max_len, self.prefill_len,
@@ -348,12 +389,14 @@ class DecodeEngine:
         self._check_slot(slot)
         self._cache = release_slot(self._cache, slot)
         self._lengths_host[slot] = 0
+        self._restored.pop(slot, None)
 
     def reset(self) -> None:
         """Free every slot (keeps compiled programs and allocations)."""
         self._cache = dataclasses.replace(
             self._cache, lengths=jnp.zeros((self.slots,), jnp.int32))
         self._lengths_host[:] = 0
+        self._restored.clear()
 
     def decode_compiles(self) -> int:
         """Number of distinct compiles of the decode step (1 == the
@@ -365,6 +408,15 @@ class DecodeEngine:
         bounded by ``len(prefill_buckets)`` (each bucket is one input
         shape), asserted in tier-1 and by the bench regression guard."""
         return compile_count(self._prefill)
+
+    def restore_compiles(self) -> int:
+        """Number of distinct compiles of the prefix-restore program —
+        bounded by ``len(prefill_buckets)`` (a restore chunk pads to
+        the same bucket table prefill uses), asserted in tier-1 and by
+        the bench regression guard.  Zero until the first
+        :meth:`restore_prefix` call — the witness that leaving prefix
+        caching off leaves the compiled-program set untouched."""
+        return compile_count(self._restore)
 
     def verify_compiles(self) -> int:
         """Number of distinct compiles of the speculative verify
@@ -427,27 +479,148 @@ class DecodeEngine:
         self._lengths_host[slot] = offset + n
         return logits
 
-    def prefill(self, slot: int, tokens: Sequence[int]) -> jax.Array:
+    def prefill(self, slot: int, tokens: Sequence[int], *,
+                resume: int = 0) -> jax.Array:
         """Fill ``slot`` with a whole prompt (chunked as needed); return
         its next-token logits ``[vocab]`` (f32).  Prompts up to
         ``max_len`` serve — anything longer than ``prefill_len`` runs as
-        ``prefill_len``-sized chunks plus a bucketed tail."""
+        ``prefill_len``-sized chunks plus a bucketed tail.
+
+        ``resume`` (default 0) resumes prefill mid-prompt over
+        restored cache state: it must equal the token count a preceding
+        :meth:`restore_prefix` placed into this slot, and ``tokens`` is
+        still the WHOLE prompt — only the uncovered suffix
+        ``tokens[resume:]`` is computed.  Because the restored K/V are
+        bit-identical to what prefill would have written, the resumed
+        chunks (and everything after) are bit-identical to a cold
+        prefill of the full prompt.  Any other nonzero-offset use is
+        still rejected loudly: silently clobbering (or silently
+        trusting) a live stream is the corruption class these guards
+        exist for.
+        """
         self._check_slot(slot)
-        if self._lengths_host[slot]:
+        resume = int(resume)
+        n = len(tokens)
+        if not 1 <= n <= self.max_len:
+            raise ValueError(f"prompt length {n} not in [1, "
+                             f"{self.max_len}] (cache capacity)")
+        if resume:
+            if (self._restored.get(slot) != resume
+                    or self._lengths_host[slot] != resume):
+                raise ValueError(
+                    f"prefill(resume={resume}) on slot {slot}: the slot "
+                    f"holds {self._lengths_host[slot]} tokens of which "
+                    f"{self._restored.get(slot, 0)} are engine-restored "
+                    f"— resume must equal the restore_prefix() length "
+                    f"exactly")
+            if n <= resume:
+                raise ValueError(
+                    f"prompt of {n} tokens has no suffix past "
+                    f"the {resume} restored tokens — at least the final "
+                    f"prompt token must be computed to produce the "
+                    f"next-token logits")
+            # every argument validated: the slot is a live stream from
+            # here on — a second resume (or a re-restore) over it must
+            # fail the guards above.  (The mark is consumed only after
+            # validation so a rejected call stays side-effect-free: the
+            # caller may retry with a corrected prompt instead of
+            # re-paying the whole device restore.)
+            self._restored.pop(slot, None)
+        elif self._lengths_host[slot]:
             raise ValueError(
                 f"slot {slot} is occupied ({self._lengths_host[slot]} "
                 f"tokens); release() it before prefilling — silently "
                 f"clobbering a live stream is the corruption class these "
                 f"guards exist for")
-        n = len(tokens)
-        if not 1 <= n <= self.max_len:
-            raise ValueError(f"prompt length {n} not in [1, "
-                             f"{self.max_len}] (cache capacity)")
         logits = None
-        for start in range(0, n, self.prefill_len):
+        for start in range(resume, n, self.prefill_len):
             logits = self.prefill_chunk(
                 slot, tokens[start:start + self.prefill_len])
         return logits
+
+    # ---- prefix-cache primitives (capture + restore) ---------------------
+    def read_region(self, slot: int, start: int, stop: int
+                    ) -> tuple[jax.Array, jax.Array]:
+        """Snapshot ``[start, stop)`` of a slot's cached K/V across every
+        layer: ``(k, v)`` of shape ``[layers, stop - start, kv_heads,
+        head_dim]`` — fresh owned buffers (safe to hold across later
+        donated cache updates).  Only *valid* rows may be read (the span
+        must sit inside the slot's committed length — bytes past it are
+        masked garbage by contract).  One compiled program per distinct
+        extent; block-granular prefix capture batches each chunk's new
+        blocks into one span read, so its compiles are bounded by
+        ``ceil(prefill_len / block_size)`` distinct extents."""
+        self._check_slot(slot)
+        start, stop = int(start), int(stop)
+        if not 0 <= start < stop <= int(self._lengths_host[slot]):
+            raise ValueError(
+                f"region [{start}, {stop}) outside slot {slot}'s valid "
+                f"length {int(self._lengths_host[slot])} — rows past the "
+                f"committed length are masked garbage and must never be "
+                f"handed out")
+        # np scalars, not jnp: a jnp.int32() wrapper costs a device_put
+        # (~35us) per argument, tripling this dispatch's host cost —
+        # and capture rides the serving hot path
+        return self._read(self._cache, np.int32(slot), np.int32(start),
+                          n=stop - start)
+
+    def restore_prefix(self, slot: int, kv, length: int) -> None:
+        """Place previously captured K/V back into a free slot: after
+        the call the slot holds ``length`` cached tokens, bit-for-bit
+        the state a cold prefill of those tokens would have produced
+        (the arrays ARE prefill's output, snapshotted via
+        :meth:`read_region`), and :meth:`prefill`/``prefill_chunk`` may
+        resume the prompt at offset ``length``.
+
+        ``kv`` is ``(k, v)`` with shape ``[layers, >= length, kv_heads,
+        head_dim]`` (extra rows are ignored).  The write runs as
+        ``prefill_len``-sized chunks padded to the prefill bucket
+        table, so restore compiles are bounded by ``len(
+        prefill_buckets)`` (:meth:`restore_compiles`).  ``length`` is
+        capped at ``max_len - 1``: a full-cache restore could never
+        compute the next-token logits the stream needs.
+        """
+        self._check_slot(slot)
+        if self._lengths_host[slot]:
+            raise ValueError(
+                f"slot {slot} is occupied ({self._lengths_host[slot]} "
+                f"tokens); release() it before restoring into it")
+        k, v = kv
+        length = int(length)
+        layers = self._cache.num_layers
+        tail = self._cache.k.shape[3:]          # (kv_heads, head_dim)
+        for name, arr in (("k", k), ("v", v)):
+            shape = tuple(getattr(arr, "shape", ()))
+            if (len(shape) != 4 or shape[0] != layers
+                    or shape[2:] != tail):
+                raise ValueError(
+                    f"restore {name} shape {shape} does not match the "
+                    f"cache's [layers={layers}, n, kv_heads={tail[0]}, "
+                    f"head_dim={tail[1]}] layout")
+        if not 1 <= length <= min(k.shape[1], v.shape[1]):
+            raise ValueError(
+                f"restore length {length} not in [1, "
+                f"{min(k.shape[1], v.shape[1])}] (rows provided)")
+        if length > self.max_len - 1:
+            raise ValueError(
+                f"restored prefix of {length} tokens leaves no room in "
+                f"a max_len={self.max_len} cache for the resume chunk "
+                f"that must produce the next-token logits")
+        dtype = self._cache.dtype
+        for start in range(0, length, self.prefill_len):
+            n = min(self.prefill_len, length - start)
+            bucket = self.bucket_for(n)
+            k_blk = jnp.zeros((layers, bucket) + tail, dtype)
+            v_blk = jnp.zeros((layers, bucket) + tail, dtype)
+            k_blk = k_blk.at[:, :n].set(
+                jnp.asarray(k[:, start:start + n], dtype))
+            v_blk = v_blk.at[:, :n].set(
+                jnp.asarray(v[:, start:start + n], dtype))
+            self._cache = self._restore(
+                self._cache, k_blk, v_blk, np.int32(slot),
+                np.int32(start), np.int32(n))
+        self._lengths_host[slot] = length
+        self._restored[slot] = length
 
     def decode(self, tokens, active) -> jax.Array:
         """One batched decode step: append ``tokens[slot]`` to every

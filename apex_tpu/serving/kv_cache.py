@@ -42,7 +42,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["KVCache", "init_cache", "prefill_into_slot", "append_token",
-           "commit_slot_length", "release_slot", "valid_token_mask"]
+           "commit_slot_length", "release_slot", "valid_token_mask",
+           "read_slot_region", "write_slot_region"]
 
 
 @functools.partial(jax.tree_util.register_dataclass,
@@ -139,6 +140,60 @@ def append_token(cache: KVCache, layer: int, k_tok, v_tok,
                                                     pos)),
         v=cache.v.at[layer].set(jax.vmap(write_one)(cache.v[layer], v_tok,
                                                     pos)))
+
+
+def read_slot_region(cache: KVCache, slot, start, stop) -> tuple:
+    """Fixed-extent gather of one slot's K/V span across every layer:
+    returns ``(k, v)`` with shape ``[layers, stop - start, kv_heads,
+    head_dim]`` — fresh owned buffers, NOT views into the cache (an XLA
+    gather materializes), so the caller may keep them alive across later
+    donated cache updates.  This is the prefix-cache *capture*
+    primitive: a completed prompt block is snapshotted from the slot
+    that just computed it.
+
+    ``slot`` and ``start`` may be traced scalars; the extent
+    ``stop - start`` must be a Python int (the gather shape is a
+    compile-time constant — block-granular captures share ONE compiled
+    read no matter where in the slot the block sits).  The caller is
+    responsible for staying inside the slot's *valid* length — rows past
+    ``lengths[slot]`` are masked garbage by contract and a region read
+    must never hand them out (``DecodeEngine.read_region`` enforces
+    this against its host-side length mirror).
+    """
+    n = int(stop) - int(start)
+    if n < 1:
+        raise ValueError(f"empty region [{start}, {stop})")
+    rows = jnp.asarray(start, jnp.int32) + jnp.arange(n, dtype=jnp.int32)
+    s = jnp.asarray(slot, jnp.int32)
+    return cache.k[:, s, rows], cache.v[:, s, rows]
+
+
+def write_slot_region(cache: KVCache, slot, start, k_region,
+                      v_region) -> KVCache:
+    """Write a K/V span into one slot across every layer at offset
+    ``start`` — the dynamic-update dual of :func:`read_slot_region` and
+    the prefix-cache *restore* primitive (a previously captured block
+    chain is placed back verbatim, so the restored rows are bit-for-bit
+    what prefill would have recomputed).
+
+    ``k_region`` / ``v_region``: ``[layers, n, kv_heads, head_dim]``;
+    ``slot`` and ``start`` may be traced.  Like
+    :func:`prefill_into_slot`, the write is a per-row scatter with
+    ``mode="drop"`` (a bucket-padded restore chunk near the cache end
+    must have its overhanging padding rows DROPPED, never clamped
+    backward onto cached tokens), and ``lengths`` is untouched — the
+    caller commits the slot's real depth via
+    :func:`commit_slot_length` once per restore chunk.
+    """
+    rows = jnp.asarray(start, jnp.int32) + jnp.arange(
+        k_region.shape[1], dtype=jnp.int32)
+    s = jnp.asarray(slot, jnp.int32)
+    return dataclasses.replace(
+        cache,
+        k=cache.k.at[:, s, rows].set(k_region.astype(cache.dtype),
+                                     mode="drop"),
+        v=cache.v.at[:, s, rows].set(v_region.astype(cache.dtype),
+                                     mode="drop"))
 
 
 def commit_slot_length(cache: KVCache, slot, length) -> KVCache:

@@ -1,0 +1,415 @@
+"""Cross-request prefix cache: reuse shared-prompt K/V, bit-exactly.
+
+Production serving traffic is dominated by requests sharing long common
+prefixes — system prompts, few-shot templates, chat history — yet a
+plain scheduler re-runs full chunked prefill over every admitted
+prompt.  PR 6 made chunk boundaries "scheduling, not numerics": chunked
+cached prefill is bit-identical to the one-shot forward at ANY split
+point, which means a previously computed prefix's K/V can be reused
+*verbatim* and prefill resumed mid-prompt with zero numerical cost —
+the RadixAttention-style insight (win by eliminating redundant work,
+not approximating it).
+
+The store is **chunk-granular**: a prompt is hashed as a chain of
+fixed-size token blocks (``block_size`` aligned to the engine's
+smallest prefill bucket by default), and each entry holds
+
+- the per-layer K/V for its block span as **owned device arrays**
+  (captured via ``DecodeEngine.read_region`` immediately after the
+  prefill chunk that completed the block — a snapshot of exactly the
+  bytes prefill wrote, so a later restore is bit-for-bit the state a
+  cold prefill would have produced).  Blocks captured together from
+  one chunk share one *span* buffer (ONE device round trip captures a
+  whole chunk's blocks — per-block copies would make the
+  zero-overlap workload pay a dispatch per block) and slice out of it
+  lazily on the hit path; a span's bytes are freed when its last
+  entry is evicted, so one surviving block can transiently pin up to
+  a chunk's span (bounded by ``prefill_len`` tokens, reported
+  honestly by ``cached_bytes``); and
+- the **chain hash** linking it to its parent block: ``H(parent_hash,
+  block_tokens)``.  Two prompts share an entry iff they share the
+  whole token prefix up to that block — position is encoded by the
+  chain, so there are no false hits.
+
+Admission does a **longest-chain match** (capped at ``len(prompt) - 1``
+tokens: the final prompt token is always recomputed, because the hit's
+resume chunk must produce the next-token logits the first sampled token
+comes from).  Eviction is LRU under a configurable token budget with
+two hard rules:
+
+- an entry whose ref-count is nonzero is NEVER evicted (the scheduler
+  pins a request's matched + self-inserted chain until its prompt is
+  fully cached, so the chain it is extending block-by-block cannot be
+  ripped out from under it mid-prefill), and
+- eviction is leaf-first (an entry with live children is not
+  evictable): every cached chain stays reachable from the root — no
+  orphaned, unmatchable entries leaking budget.  For the same reason
+  :meth:`PrefixCache.put` refuses an insert whose parent is gone.
+
+Everything here is host-side bookkeeping; the only device work a hit
+costs is the engine's bucketed ``restore_prefix`` writes (and the only
+device work capture costs is one fixed-extent region read per new
+block).  Opt-in via ``ContinuousBatchingScheduler(...,
+prefix_caching=PrefixCacheConfig(...))``; the default (off) leaves
+every existing serving path byte-for-byte untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu._logging import get_logger
+
+__all__ = ["PrefixCacheConfig", "PrefixCache"]
+
+logger = get_logger("serving.prefix_cache")
+
+_ROOT = "root"          # chain hash of the empty prefix
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Opt-in knob for cross-request prefix caching.
+
+    ``block_size``: tokens per hashed block (``None`` — the scheduler
+    aligns it to the engine's smallest prefill bucket, so a restored
+    chain always lands on bucket-friendly chunk boundaries).
+    ``max_tokens``: cached-token budget — LRU eviction keeps the store
+    at or under it whenever any unpinned, childless entry exists
+    (pinned chains may transiently exceed it; see
+    :meth:`PrefixCache.put`).
+    """
+
+    block_size: Optional[int] = None
+    max_tokens: int = 1 << 20
+
+    def __post_init__(self):
+        if self.block_size is not None and self.block_size < 1:
+            raise ValueError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.max_tokens < 1:
+            raise ValueError(
+                f"max_tokens must be >= 1, got {self.max_tokens}")
+
+
+@dataclasses.dataclass
+class _Span:
+    """One captured region's owned device buffers, shared by the blocks
+    captured together (``live`` counts the entries still referencing
+    it; its bytes are freed — the arrays dropped — when the last one
+    is evicted)."""
+
+    k: object                    # [layers, rows, kv_heads, head_dim]
+    v: object
+    nbytes: int
+    live: int = 0
+
+
+@dataclasses.dataclass
+class _Entry:
+    chain: str                   # this entry's chain hash
+    parent: str                  # parent block's chain hash (or root)
+    tokens: Tuple[int, ...]      # the block's tokens (len == block_size)
+    span: _Span                  # shared captured buffers
+    lo: int                      # this block's row offset inside span
+    refs: int = 0                # live pins; > 0 == never evictable
+
+
+class PrefixCache:
+    """Chain-hashed block store over captured K/V (host bookkeeping).
+
+    >>> cache = PrefixCache(block_size=16, max_tokens=4096)
+    >>> covered, entries = cache.match(prompt)       # longest chain
+    >>> cache.acquire(entries)                       # pin while feeding
+    >>> h = cache.put(parent_hash, block, k, v)      # insert-on-miss
+    >>> cache.release(entries)                       # prompt cached
+
+    Not thread-safe by design: the continuous-batching scheduler is a
+    single host loop, and every call here happens at a step boundary.
+    """
+
+    ROOT = _ROOT
+
+    def __init__(self, *, block_size: int, max_tokens: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
+        self.block_size = int(block_size)
+        self.max_tokens = int(max_tokens)
+        # LRU order IS the dict order: touch == move_to_end, eviction
+        # scans from the oldest end for the first evictable entry —
+        # O(1) in the common case instead of a full min() scan of a
+        # store that can hold tens of thousands of blocks at budget
+        self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
+        self._children: Dict[str, Set[str]] = {}
+        self._span_bytes = 0     # bytes of spans with >= 1 live entry
+        self._hits = 0
+        self._misses = 0
+        self._inserted = 0
+        self._evicted = 0
+        self._refused = 0
+
+    # ---- hashing ---------------------------------------------------------
+    @staticmethod
+    def chain_hash(parent: str, tokens: Sequence[int]) -> str:
+        """``H(parent_hash, block_tokens)`` — equal iff the WHOLE token
+        prefix up to and including this block is equal, so a chain hash
+        encodes both content and position.  BLAKE2b over the raw int64
+        token bytes: hashing rides the serving hot path (every block of
+        every admitted prompt), and a string-join digest measurably
+        taxed the zero-overlap no-regression bar."""
+        h = hashlib.blake2b(parent.encode("ascii"), digest_size=16)
+        h.update(np.asarray(tokens, dtype="<i8").tobytes())
+        return h.hexdigest()
+
+    # ---- introspection ---------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, chain: str) -> bool:
+        return chain in self._entries
+
+    @property
+    def cached_tokens(self) -> int:
+        return len(self._entries) * self.block_size
+
+    @property
+    def cached_bytes(self) -> int:
+        """Bytes of live span buffers — the honest device-memory
+        figure: a span stays allocated until its LAST entry is evicted,
+        so this can exceed ``cached_tokens``-worth of bytes while a
+        partially evicted span survives (bounded by one chunk's rows
+        per surviving span)."""
+        return self._span_bytes
+
+    def stats(self) -> Dict[str, int]:
+        """Cumulative structural accounting (the bench and tests read
+        this; per-request hit/miss telemetry rides the scheduler's
+        ``serving_prefix_{hit,miss}`` events)."""
+        return {"entries": len(self._entries),
+                "cached_tokens": self.cached_tokens,
+                "cached_bytes": self.cached_bytes,
+                "hits": self._hits, "misses": self._misses,
+                "inserted": self._inserted, "evicted": self._evicted,
+                "refused": self._refused}
+
+    # ---- lookup ----------------------------------------------------------
+    def _touch(self, entry: _Entry) -> None:
+        self._entries.move_to_end(entry.chain)
+
+    def match(self, prompt: Sequence[int]) -> Tuple[int, List[_Entry]]:
+        """Longest cached chain covering a prefix of ``prompt``; returns
+        ``(covered_tokens, entries)`` with ``covered_tokens`` a multiple
+        of ``block_size`` and **at most** ``len(prompt) - 1`` (the final
+        token is always recomputed so the resume chunk yields the
+        next-token logits).  Matched entries are LRU-touched but NOT
+        pinned — call :meth:`acquire` before any host work that could
+        insert (and therefore evict)."""
+        n = len(prompt)
+        h = _ROOT
+        out: List[_Entry] = []
+        pos = 0
+        while pos + self.block_size <= n - 1:
+            h = self.chain_hash(h, prompt[pos:pos + self.block_size])
+            entry = self._entries.get(h)
+            if entry is None:
+                break
+            out.append(entry)
+            pos += self.block_size
+        for entry in out:
+            self._touch(entry)
+        if out:
+            self._hits += 1
+        else:
+            self._misses += 1
+        return pos, out
+
+    def lookup(self, chain: str) -> Optional[_Entry]:
+        """The live entry for a chain hash (LRU-touched), or ``None`` —
+        the cheap presence probe capture uses to skip the device read
+        for a block another stream already inserted."""
+        entry = self._entries.get(chain)
+        if entry is not None:
+            self._touch(entry)
+        return entry
+
+    # ---- pinning ---------------------------------------------------------
+    def acquire(self, entries: Sequence[_Entry]) -> None:
+        """Pin entries feeding a live slot: refs > 0 blocks eviction."""
+        for entry in entries:
+            entry.refs += 1
+
+    def release(self, entries: Sequence[_Entry]) -> None:
+        """Drop one pin per entry (the prompt they fed is fully cached)."""
+        for entry in entries:
+            if entry.refs < 1:
+                raise ValueError(
+                    f"release of unpinned entry {entry.chain[:12]} — "
+                    f"acquire/release must pair")
+            entry.refs -= 1
+
+    # ---- insert + eviction -----------------------------------------------
+    def put(self, parent: str, tokens: Sequence[int], k, v
+            ) -> Optional[_Entry]:
+        """Insert one captured block (its own single-block span) — the
+        convenience form of :meth:`put_blocks` for direct engine users
+        and tests; the scheduler inserts a whole chunk's blocks at once
+        with one shared span."""
+        out = self.put_blocks(parent, [tokens], k, v)
+        return out[0] if out else None
+
+    def put_blocks(self, parent: str, blocks: Sequence[Sequence[int]],
+                   k_span, v_span) -> List[_Entry]:
+        """Insert consecutive captured blocks sharing ONE span buffer
+        pair (``k_span`` / ``v_span``: ``[layers, len(blocks) *
+        block_size, kv_heads, head_dim]`` — exactly the rows block 0
+        starts at, in order).  Idempotent per block: an existing chain
+        entry is touched and returned as-is (its original span is THE
+        copy; a re-capture of the same chain is bit-identical by the
+        exactness contract anyway).  Stops — returning the entries
+        inserted so far — at the first block whose parent chain is gone
+        (evicted mid-prefill under a tight budget): an orphaned entry
+        could never be matched and would leak budget forever.
+
+        After the inserts, evicts LRU-childless-unpinned entries until
+        the token budget holds again — this call's own fresh entries
+        are protected from its own eviction pass, so the returned
+        entries are always LIVE (callers pin them before any later
+        insert can run).  When every entry is pinned or has live
+        children the store may transiently exceed the budget rather
+        than corrupt a chain a live slot is feeding.
+        """
+        rows = int(k_span.shape[1])
+        if rows != len(blocks) * self.block_size:
+            raise ValueError(
+                f"span of {rows} rows != {len(blocks)} blocks x "
+                f"block_size {self.block_size}")
+        nbytes = (int(getattr(k_span, "nbytes", 0))
+                  + int(getattr(v_span, "nbytes", 0)))
+        span = _Span(k=k_span, v=v_span, nbytes=nbytes)
+        out: List[_Entry] = []
+        created: List[_Entry] = []
+        for i, block in enumerate(blocks):
+            tokens = tuple(map(int, block))
+            if len(tokens) != self.block_size:
+                raise ValueError(
+                    f"block of {len(tokens)} tokens != block_size "
+                    f"{self.block_size} — only whole blocks are "
+                    f"hashable")
+            chain = self.chain_hash(parent, tokens)
+            entry = self._entries.get(chain)
+            if entry is None:
+                if parent != _ROOT and parent not in self._entries:
+                    self._refused += 1
+                    logger.debug("prefix put refused: parent %.12s "
+                                 "evicted", parent)
+                    break
+                entry = _Entry(chain=chain, parent=parent, tokens=tokens,
+                               span=span, lo=i * self.block_size)
+                self._entries[chain] = entry
+                self._children.setdefault(parent, set()).add(chain)
+                if span.live == 0:
+                    self._span_bytes += span.nbytes
+                span.live += 1
+                self._inserted += 1
+                created.append(entry)
+            self._touch(entry)
+            out.append(entry)
+            parent = chain
+        # the call's own fresh entries are pinned THROUGH the eviction
+        # pass: without this, a tight budget whose every other entry is
+        # pinned would evict the blocks just inserted before the caller
+        # can acquire them — handing back dead entries, killing the
+        # chain a live prefill is extending, and (downstream) breaking
+        # the capture path's bounded-compile contract.  The returned
+        # entries are guaranteed live; callers pin them before any
+        # later insert can run.
+        for entry in created:
+            entry.refs += 1
+        try:
+            self._evict_to_budget()
+        finally:
+            for entry in created:
+                entry.refs -= 1
+        return out
+
+    @staticmethod
+    def gather_kv(entries: Sequence[_Entry]) -> Tuple[object, object]:
+        """Concatenate a matched chain's K/V for restore, slicing each
+        span at most once: consecutive entries from the same span
+        coalesce into one slice (a whole span passes through with no
+        device op at all), so restoring a chain captured from one
+        chunk costs one slice — not one per block."""
+        if not entries:
+            raise ValueError("gather_kv of an empty chain")
+        parts_k, parts_v = [], []
+        i = 0
+        while i < len(entries):
+            first = entries[i]
+            j = i
+            while (j + 1 < len(entries)
+                   and entries[j + 1].span is first.span
+                   and entries[j + 1].lo == entries[j].lo
+                   + len(entries[j].tokens)):
+                j += 1
+            hi = entries[j].lo + len(entries[j].tokens)
+            if first.lo == 0 and hi == int(first.span.k.shape[1]):
+                parts_k.append(first.span.k)
+                parts_v.append(first.span.v)
+            else:
+                parts_k.append(first.span.k[:, first.lo:hi])
+                parts_v.append(first.span.v[:, first.lo:hi])
+            i = j + 1
+        if len(parts_k) == 1:
+            return parts_k[0], parts_v[0]
+        return (jnp.concatenate(parts_k, axis=1),
+                jnp.concatenate(parts_v, axis=1))
+
+    def _evictable(self, entry: _Entry) -> bool:
+        return not entry.refs and not self._children.get(entry.chain)
+
+    def _evict_to_budget(self) -> None:
+        while self.cached_tokens > self.max_tokens:
+            victim = next(
+                (e for e in self._entries.values() if self._evictable(e)),
+                None)               # oldest-first: dict order IS LRU order
+            if victim is None:
+                # everything left is pinned or mid-chain: exceeding the
+                # budget transiently beats corrupting a live chain
+                logger.debug(
+                    "prefix cache over budget (%d > %d tokens) with no "
+                    "evictable entry", self.cached_tokens, self.max_tokens)
+                return
+            del self._entries[victim.chain]
+            siblings = self._children.get(victim.parent)
+            if siblings is not None:
+                siblings.discard(victim.chain)
+                if not siblings:
+                    del self._children[victim.parent]
+            self._children.pop(victim.chain, None)
+            victim.span.live -= 1
+            if victim.span.live == 0:
+                # last entry of the span gone: its device buffers are
+                # droppable now (nothing else references them)
+                self._span_bytes -= victim.span.nbytes
+            self._evicted += 1
+
+    def clear(self) -> None:
+        """Drop every entry (refuses while any entry is pinned — a live
+        slot is still being fed from the store)."""
+        pinned = [e.chain for e in self._entries.values() if e.refs]
+        if pinned:
+            raise ValueError(
+                f"clear() with {len(pinned)} pinned entr"
+                f"{'y' if len(pinned) == 1 else 'ies'} — release the "
+                f"live slots first")
+        self._entries.clear()
+        self._children.clear()
+        self._span_bytes = 0

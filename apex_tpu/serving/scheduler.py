@@ -37,8 +37,27 @@ boundaries:
   no-match streams and sampled-temperature requests ride the plain
   batched decode step, the latter byte-for-byte (no drafting, no
   verify compiles, no extra events or metrics).
+- **Cross-request prefix caching** (opt-in via
+  ``prefix_caching=PrefixCacheConfig(...)``): at admission the prompt
+  is matched against a chain-hashed block store
+  (:mod:`apex_tpu.serving.prefix_cache`) and the longest cached prefix
+  is *restored* into the fresh slot
+  (:meth:`~apex_tpu.serving.engine.DecodeEngine.restore_prefix`) —
+  the prefill budget is then spent only on the uncovered suffix.
+  Completed prompt blocks are offered back insert-on-miss (snapshotted
+  from the slot immediately after the chunk that completed them), and
+  every entry feeding a live prefill is ref-count-pinned against
+  eviction.  Because restored K/V are bit-identical to what prefill
+  would have written, a hit changes *nothing* about the stream: same
+  logits, same tokens, bit for bit.  Off (the default), every
+  existing path — tokens, events, metrics, compiles — is
+  byte-for-byte untouched.
 - **Telemetry**: structured ``emit_event`` lines
   (:mod:`apex_tpu._logging`) — ``serving_request_admitted`` /
+  ``serving_prefix_hit`` / ``serving_prefix_miss`` (admission-time
+  cache outcome; hits carry ``saved_tokens`` + restore wall time,
+  feeding the ``apex_serving_prefix_{hit,miss}_total`` counters and
+  the ``apex_serving_prefix_saved_tokens`` histogram) /
   ``serving_prefill_chunk`` (per-chunk bucket + dispatch wall time,
   feeding the ``apex_serving_prefill_duration_seconds{bucket}``
   histogram) / ``serving_spec_verify`` (per-verify drafted/accepted
@@ -51,8 +70,10 @@ boundaries:
   ``log_interval`` steps.  Current-state gauges
   (:mod:`apex_tpu.obs.bridge`: ``apex_serving_queue_depth`` /
   ``apex_serving_slot_occupancy`` / ``apex_serving_cache_utilization``
-  / ``apex_serving_prefill_backlog``) refresh every step, so a
-  Prometheus scrape sees live state regardless of ``log_interval``.
+  / ``apex_serving_prefill_backlog``, plus
+  ``apex_serving_prefix_cached_tokens`` when prefix caching is on)
+  refresh every step, so a Prometheus scrape sees live state
+  regardless of ``log_interval``.
 
 Determinism: sampling draws from explicit per-request PRNG keys
 (``fold_in(PRNGKey(seed), token_index)``) — the clock feeds telemetry
@@ -74,6 +95,7 @@ from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import DecodeEngine, request_key
+from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
            "ContinuousBatchingScheduler"]
@@ -133,6 +155,13 @@ class _Active:
     prompt_pos: int = 0      # prompt tokens cached so far
     phase: RequestPhase = RequestPhase.PREFILL
     draft_k: int = 0         # adaptive draft length (speculation only)
+    # prefix-caching state (unused when prefix_caching is off):
+    # the chain hash of the last prompt block this request matched or
+    # captured, how many blocks that is, and the entries pinned on its
+    # behalf until the prompt is fully cached
+    chain: str = PrefixCache.ROOT
+    blocks_cached: int = 0
+    pinned: List = dataclasses.field(default_factory=list)
 
     @property
     def prompt_remaining(self) -> int:
@@ -158,6 +187,7 @@ class ContinuousBatchingScheduler:
                  log_interval: int = 32,
                  prefill_budget: Optional[int] = None,
                  speculation: Optional[SpeculationConfig] = None,
+                 prefix_caching: Optional[PrefixCacheConfig] = None,
                  clock: Callable[[], float] = time.monotonic):
         if prefill_budget is None:
             prefill_budget = engine.prefill_len
@@ -176,6 +206,23 @@ class ContinuousBatchingScheduler:
         self.log_interval = max(1, int(log_interval))
         self.prefill_budget = int(prefill_budget)
         self.speculation = speculation
+        # cross-request prefix caching (opt-in; None == off leaves every
+        # existing path byte-for-byte untouched — no events, no gauge
+        # sets, no extra engine programs).  Block size defaults to the
+        # engine's smallest prefill bucket so restored chains land on
+        # bucket-friendly chunk boundaries.
+        self._prefix: Optional[PrefixCache] = None
+        if prefix_caching is not None:
+            block = (prefix_caching.block_size
+                     if prefix_caching.block_size is not None
+                     else engine.prefill_buckets[0])
+            if block > engine.max_len - 1:
+                raise ValueError(
+                    f"prefix block_size {block} cannot fit a "
+                    f"max_len={engine.max_len} cache alongside the "
+                    f"resume token")
+            self._prefix = PrefixCache(
+                block_size=block, max_tokens=prefix_caching.max_tokens)
         self._clock = clock
         self._queue: deque[tuple[Request, float]] = deque()
         self._active: Dict[int, _Active] = {}
@@ -296,6 +343,115 @@ class ContinuousBatchingScheduler:
             emit_event("serving_request_admitted", rid=request.rid,
                        slot=slot, prompt_tokens=len(request.prompt),
                        queue_depth=len(self._queue))
+            if self._prefix is not None:
+                self._match_and_restore(st)
+
+    # ---- prefix caching (opt-in; every call below is guarded by
+    # ``self._prefix is not None``, so the default path never changes) --
+    @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        """The live :class:`PrefixCache` when ``prefix_caching`` is
+        enabled (``None`` otherwise) — introspection for tests/bench."""
+        return self._prefix
+
+    def _match_and_restore(self, st: _Active) -> None:
+        """Admission-time prefix reuse: longest-chain match against the
+        prompt, bucketed restore of the hit into the fresh slot, and a
+        pin on every matched entry until the prompt is fully cached.
+        The per-step prefill budget is then spent only on the uncovered
+        suffix (``st.prompt_pos`` starts past the restored tokens) —
+        and because the restored K/V are bit-identical to what prefill
+        would have written, the stream from here on is bit-identical to
+        a cold admission."""
+        request = st.request
+        covered, entries = self._prefix.match(request.prompt)
+        if not covered:
+            emit_event("serving_prefix_miss", rid=request.rid,
+                       prompt_tokens=len(request.prompt))
+            return
+        t0 = self._clock()
+        self.engine.restore_prefix(st.slot,
+                                   self._prefix.gather_kv(entries),
+                                   covered)
+        dt = self._clock() - t0
+        self._prefix.acquire(entries)
+        st.pinned = list(entries)
+        st.prompt_pos = covered
+        st.chain = entries[-1].chain
+        st.blocks_cached = len(entries)
+        emit_event("serving_prefix_hit", rid=request.rid,
+                   saved_tokens=covered, blocks=len(entries),
+                   prompt_tokens=len(request.prompt),
+                   duration_s=round(dt, 6))
+
+    def _offer_blocks(self, st: _Active) -> None:
+        """Insert-on-miss capture: every prompt block the slot has
+        fully cached and not yet offered is snapshotted — a
+        ``read_region`` over exactly the rows prefill just wrote,
+        immediately after the chunk that completed the block, so the
+        entry is deterministically THE bytes a later restore must
+        reproduce — and chained into the cache.  Each entry this
+        request matches or inserts is pinned until its prompt is fully
+        cached, so the chain it is still extending cannot be evicted
+        mid-prefill (a parentless insert would be refused).
+
+        Device cost is kept off the zero-overlap worst case: blocks
+        another stream already cached are advanced over with a pure
+        host-side hash probe (no read), and the remaining missing
+        blocks of this chunk — always a contiguous tail, because a
+        chain hash cannot exist without its parent — are snapshotted
+        in ONE batched region read and sliced per block."""
+        block = self._prefix.block_size
+        total = st.prompt_pos // block     # complete blocks available
+        # 1) advance over blocks another stream already inserted
+        while st.blocks_cached < total:
+            lo = st.blocks_cached * block
+            blk = st.request.prompt[lo:lo + block]
+            entry = self._prefix.lookup(self._prefix.chain_hash(st.chain,
+                                                                blk))
+            if entry is None:
+                break
+            self._prefix.acquire([entry])
+            st.pinned.append(entry)
+            st.chain = entry.chain
+            st.blocks_cached += 1
+        missing = total - st.blocks_cached
+        if missing <= 0:
+            return
+        # 2) batched snapshots of every missing block — a region read
+        # whose span buffer the new entries share (the zero-overlap
+        # overhead budget is ONE dispatch per chunk), inserted in
+        # chain order.  Spans are clamped to a chunk's worth of blocks
+        # so the read program's compile count stays bounded by
+        # ceil(prefill_len / block) STRUCTURALLY, even if a pathology
+        # ever left more than one chunk's blocks pending.
+        max_span = max(1, self.engine.prefill_len // block)
+        while missing > 0:
+            count = min(missing, max_span)
+            lo = st.blocks_cached * block
+            k_span, v_span = self.engine.read_region(
+                st.slot, lo, lo + count * block)
+            blocks = [st.request.prompt[lo + i * block:
+                                        lo + (i + 1) * block]
+                      for i in range(count)]
+            entries = self._prefix.put_blocks(st.chain, blocks, k_span,
+                                              v_span)
+            for entry in entries:
+                self._prefix.acquire([entry])
+                st.pinned.append(entry)
+                st.chain = entry.chain
+                st.blocks_cached += 1
+            if len(entries) < count:
+                # parent evicted under a tight budget (unreachable
+                # while this chain is pinned — defensive): stop
+                # extending rather than re-reading a growing span
+                return
+            missing -= count
+
+    def _release_pins(self, st: _Active) -> None:
+        if st.pinned:
+            self._prefix.release(st.pinned)
+            st.pinned = []
 
     def _prefill_work(self) -> List[str]:
         """Spend up to ``prefill_budget`` prompt tokens on chunks,
@@ -324,6 +480,8 @@ class ContinuousBatchingScheduler:
                            bucket=self.engine.bucket_for(chunk),
                            chunk_tokens=chunk, offset_tokens=offset,
                            duration_s=round(dt, 6))
+                if self._prefix is not None:
+                    self._offer_blocks(st)
                 if not st.prompt_remaining:
                     tok = int(self.engine.sample(
                         logits[None], st.base_key[None], np.int32([0]),
@@ -332,6 +490,10 @@ class ContinuousBatchingScheduler:
                     st.t_first = self._clock()
                     st.tokens.append(tok)
                     st.phase = RequestPhase.DECODE
+                    if self._prefix is not None:
+                        # the prompt is fully cached: the chain it was
+                        # matching/extending no longer needs protection
+                        self._release_pins(st)
                     emit_event("serving_first_token", rid=st.request.rid,
                                ttft_s=round(st.t_first - st.t_submit, 6))
                     if self._finish_if_done(st):
@@ -504,6 +666,11 @@ class ContinuousBatchingScheduler:
         obs_bridge.SERVING_SLOT_OCCUPANCY.set(occupancy)
         obs_bridge.SERVING_CACHE_UTILIZATION.set(cache_util)
         obs_bridge.SERVING_PREFILL_BACKLOG.set(backlog)
+        if self._prefix is not None:
+            # only when enabled: the off path must leave the metric
+            # stream byte-for-byte untouched (the identity contract)
+            obs_bridge.SERVING_PREFIX_CACHED_TOKENS.set(
+                self._prefix.cached_tokens)
         # every step like the others (a cheap host-side jit-cache read):
         # a scrape during the first log_interval steps must not read 0
         # for a gauge documented as "1 == shape-stable"

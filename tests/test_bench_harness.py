@@ -348,6 +348,54 @@ def test_serving_spec_metrics_block():
             >= r["workloads"]["adversarial"]["accept_rate"])
 
 
+def test_serving_prefix_metrics_block():
+    """The cross-request prefix-caching block (ISSUE 10): aggregate
+    prefill tokens/s for 8 requests sharing a long system prompt —
+    caching off vs cold cache vs warm cache — plus a zero-overlap
+    workload where the cache can only cost.  Bars: warm >= 2x cold on
+    the shared-prefix workload, no regression (>= 1.0x best-of-N)
+    without overlap; streams token-identical across off/cold/warm on
+    every attempt (the speedup is elided prefill, never drift); and
+    the compile guards — restore compiles bounded by the prefill
+    bucket table, decode compiles == 1 untouched.
+
+    The zero-overlap bar is "no regression within the harness's own
+    measured noise floor": copy-based capture has a real but sub-noise
+    cost (~0.5-1% on a prefill-only drain at this scale — see the
+    block's docstring and PERF_NOTES), so the block compares medians
+    and measures the wider of the two pools' own spreads as the
+    yardstick; a genuine regression is a consistent gap between
+    tight pools and fails.  attempts=3
+    (the default) keeps the pooled medians robust to one slow drain —
+    at attempts=2 the 2-sample on-side median is a mean, and a single
+    scheduler hiccup flaked the bar."""
+    r = bench._serving_prefix_metrics()
+    assert r["ok"] is True
+    # exactness is asserted inside the block on EVERY attempt — a
+    # speedup from a diverged stream would be a lie, not a win
+    assert r["streams_identical"] is True
+    shared = r["shared_prefix"]
+    # the ISSUE-10 acceptance bars
+    assert shared["speedup_warm_vs_cold"] >= 2.0, r
+    zero = r["zero_overlap"]
+    assert zero["no_regression_within_noise"] is True, r
+    # hard floor: a sub-noise capture tax is tolerated, a real
+    # slowdown is not, no matter how noisy the host claims to be
+    assert zero["ratio_on_vs_off"] >= 0.9, r
+    for k in ("prefill_tokens_per_s_off", "prefill_tokens_per_s_cold",
+              "prefill_tokens_per_s_warm"):
+        assert shared[k] > 0.0, k
+    # a hit restores the shared tokens, so a warm admission must also
+    # beat the caching-off baseline, not just its own cold pass
+    assert shared["speedup_warm_vs_off"] > 1.0, r
+    # compile-count guards: bounded by the bucket table, and the
+    # batched decode step still compiles exactly once
+    assert r["prefill_buckets"] == [16, 32, 64, 128]
+    assert 1 <= r["restore_compiles"] <= len(r["prefill_buckets"])
+    assert 1 <= r["prefill_compiles"] <= len(r["prefill_buckets"])
+    assert r["decode_compiles"] == 1
+
+
 def test_obs_metrics_block():
     """The observability-tax block (ISSUE 6 satellite): per-update cost
     of each instrument kind, span enter/exit, and exposition latency at
@@ -394,4 +442,6 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     assert result["serving"]["ok"] is True
     assert result["serving_spec"]["ok"] is True
     assert result["serving_spec"]["streams_identical"] is True
+    assert result["serving_prefix"]["ok"] is True
+    assert result["serving_prefix"]["streams_identical"] is True
     assert result["obs"]["ok"] is True

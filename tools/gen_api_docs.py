@@ -91,6 +91,7 @@ PAGES = {
     "serving": ("Serving (KV-cached decode + continuous batching)", [
         "apex_tpu.serving", "apex_tpu.serving.kv_cache",
         "apex_tpu.serving.engine", "apex_tpu.serving.draft",
+        "apex_tpu.serving.prefix_cache",
         "apex_tpu.serving.scheduler", "apex_tpu.serving.weights",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
@@ -554,6 +555,75 @@ RAG with quoted context, self-repeating generations), ≈ 1.0x when the
 drafter never matches (the adversarial bar `bench.py serving_spec`
 records).
 
+## Cross-request prefix caching (shared prompts served once)
+
+Production traffic is dominated by requests sharing long common
+prefixes — system prompts, few-shot templates, chat history — yet a
+plain scheduler re-runs full prefill over every admitted prompt.
+Because chunked cached prefill is bit-identical at ANY split point
+(above), a previously computed prefix's K/V can be reused *verbatim*
+and prefill resumed mid-prompt with zero numerical cost.
+`ContinuousBatchingScheduler(..., prefix_caching=PrefixCacheConfig())`
+turns this on (default off: every existing path stays byte-for-byte
+untouched — same tokens, same event/metric sequences, same compile
+counts).
+
+- **Block hashing** (`serving.prefix_cache`): a prompt is hashed as a
+  chain of fixed-size token blocks (`block_size`, default = the
+  engine's smallest prefill bucket); each entry's key is
+  `H(parent_hash, block_tokens)`, so equal hashes mean an equal WHOLE
+  prefix — position is encoded by the chain, and there are no false
+  hits.  Admission takes the longest matching chain, capped at
+  `len(prompt) - 1` tokens: the final prompt token is always
+  recomputed, because the resume chunk must produce the next-token
+  logits the first sampled token comes from.
+- **Capture** is deterministic and insert-on-miss: immediately after
+  the prefill chunk that completes a block, the scheduler snapshots
+  exactly the rows prefill wrote (`DecodeEngine.read_region` — a
+  fixed-extent gather into owned buffers; one dispatch covers all of
+  a chunk's new blocks, which share one *span* buffer and slice out
+  of it lazily on the hit path).
+- **Restore** (`DecodeEngine.restore_prefix`) writes the matched
+  chain back through the same per-row `mode="drop"` scatter prefill
+  uses (`kv_cache.write_slot_region`) in bucket-padded chunks —
+  restore compiles are bounded by the prefill bucket table
+  (`restore_compiles()`), and `prefill(slot, tokens, resume=n)`
+  resumes the prompt over the restored state (the offset-prefill
+  rejection is lifted ONLY for engine-verified restored slots).
+- **The exactness argument**: the entry's bytes ARE prefill's output
+  for that exact token prefix, snapshotted; the restore writes them
+  back bit-for-bit; and the resumed chunk reads the whole masked
+  cache through the same fixed-extent attention as always.  Nothing
+  in the pipeline rounds, re-orders, or approximates — so a hit
+  changes *nothing*: logits, tokens, and greedy streams are
+  bit-identical to the cold path (tier-1 pins the full trajectory,
+  `tests/test_serving_prefix.py`).
+- **Eviction and memory accounting**: LRU under a configurable
+  `max_tokens` budget, leaf-first along chains (a parent with live
+  children is never evicted, so every cached chain stays reachable —
+  no orphaned entries leaking budget; an insert whose parent is gone
+  is refused).  Entries feeding a live slot are **ref-count pinned**:
+  a request pins its matched + self-inserted chain until its prompt
+  is fully cached, and a pinned entry is never evicted (the store may
+  transiently exceed the budget instead).  `cached_tokens` is exact;
+  `cached_bytes` reports live span buffers honestly — a span's bytes
+  free only when its last block is evicted, so one surviving block
+  can transiently pin up to a chunk's span.
+
+Telemetry: `serving_prefix_hit` / `serving_prefix_miss` events at
+admission (hits carry `saved_tokens` + restore wall time), feeding
+`apex_serving_prefix_{hit,miss}_total` and the
+`apex_serving_prefix_saved_tokens` histogram, plus the
+`apex_serving_prefix_cached_tokens` gauge refreshed each scheduler
+step while caching is enabled.  `bench.py`'s `serving_prefix` block
+measures 8 requests sharing a long system prompt — warm-cache
+admissions ≥ 2× the cold pass on aggregate prefill tokens/s, and no
+regression on a zero-overlap workload *within the harness's own
+measured noise floor* (capture is copy-based, so its true
+cost is real but sub-noise — ~0.5–1% of a prefill-only drain at bench
+scale; a regression beyond the measured noise fails the bar), streams
+asserted token-identical, restore compiles bounded.
+
 ## Determinism guarantees
 
 - **Prefill and greedy decode are bit-identical to the uncached
@@ -590,7 +660,9 @@ histogram), `serving_spec_verify` (drafted/accepted counts + dispatch
 wall time — feeding the speculation counters and the
 `apex_serving_spec_accepted_tokens` acceptance-length histogram),
 `serving_first_token` (TTFT), `serving_request_finished`
-(tokens/s, per-token latency, finish reason), and a periodic
+(tokens/s, per-token latency, finish reason), `serving_prefix_hit` /
+`serving_prefix_miss` (admission-time prefix-cache outcome; hits
+carry `saved_tokens` + restore wall time), and a periodic
 `serving_step` sample (queue depth, active slots, prefill backlog).
 `bench.py` captures a `serving` block — prefill tokens/s, steady-state
 decode ms/token, continuous-batching aggregate throughput at 1/4/8
@@ -603,7 +675,12 @@ by ≥ 1.5× with `prefill_compiles` ≤ the bucket count and
 on an acceptance-friendly repetitive workload (bar ≥ 1.8×) and on an
 adversarial random-token workload (bar ≥ 1.0× — no regression), with
 `verify_compiles` bounded by the draft bucket table and
-`decode_compiles == 1` preserved.
+`decode_compiles == 1` preserved — and a `serving_prefix` block:
+cold-vs-warm prefix-cache admissions for 8 shared-prompt streams
+(warm ≥ 2× cold on aggregate prefill tokens/s; no regression without
+overlap, asserted against the harness's own measured noise
+floor; streams token-identical; restore compiles bounded by
+the prefill bucket table).
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -661,6 +738,10 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_cache_utilization` | gauge | `DecodeEngine.cache_utilization()`, every step |
 | `apex_serving_decode_compiles` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
 | `apex_serving_prefill_backlog` | gauge | scheduler, every step (prompt tokens deferred by the prefill budget) |
+| `apex_serving_prefix_hit_total` | counter | `serving_prefix_hit` events (admissions that restored a cached prompt prefix) |
+| `apex_serving_prefix_miss_total` | counter | `serving_prefix_miss` events (admissions with no cached prefix to reuse) |
+| `apex_serving_prefix_saved_tokens` | histogram | `serving_prefix_hit` events (prompt tokens restored per hit — prefill work not re-run; token-count buckets) |
+| `apex_serving_prefix_cached_tokens` | gauge | scheduler, every step while prefix caching is enabled (tokens of K/V held by the cross-request prefix cache) |
 | `apex_serving_spec_drafted_total` | counter | `serving_spec_verify` events (draft tokens proposed by prompt lookup) |
 | `apex_serving_spec_accepted_total` | counter | `serving_spec_verify` events (drafted tokens the verify argmax accepted) |
 | `apex_serving_spec_rejected_total` | counter | `serving_spec_verify` events (drafted − accepted; rolled back, never emitted) |
@@ -1026,6 +1107,48 @@ events and metrics).  Acceptance telemetry rides
 `apex_serving_spec_speedup` gauge (tokens emitted per verify
 dispatch); `bench.py`'s `serving_spec` block records the honest
 speedup on both a repetitive and an adversarial workload.
+
+Serve a fleet of chatbots off one system prompt — when every request
+opens with the same long system prompt (or few-shot template, or chat
+history), re-running prefill over the shared prefix is the dominant
+admission cost.  Cross-request prefix caching eliminates it **without
+changing a single bit**: completed prompt blocks are snapshotted into
+a chain-hashed store, and each new admission restores the longest
+cached chain verbatim and prefills only its own suffix
+([full page](api/serving.md)):
+
+```python
+sched = sv.ContinuousBatchingScheduler(
+    eng, max_queue=64,
+    prefix_caching=sv.PrefixCacheConfig(
+        max_tokens=1 << 20))   # cached-K/V budget (LRU past it;
+                               # entries feeding live slots are
+                               # ref-count pinned, never evicted)
+
+system = load_system_prompt()            # say, 1500 tokens
+for i, user_turn in enumerate(traffic):  # the fleet
+    sched.submit(sv.Request(f"u{i}", system + user_turn,
+                            max_new_tokens=256, eos_id=2))
+results = sched.run()
+```
+
+The first admission prefills the whole prompt and populates the cache
+(insert-on-miss, deterministic capture right after each chunk); every
+later admission restores the shared 1500 tokens in a handful of
+bucketed writes and spends its prefill budget on the user turn alone —
+time-to-first-token drops by roughly the shared fraction.  Because the
+restored K/V are bit-for-bit what prefill would have written, token
+streams, logits, and greedy choices are identical to a cold cache
+(tier-1 pins the full trajectory).  Hits and saved tokens ride
+`apex_serving_prefix_{hit,miss}_total` and
+`apex_serving_prefix_saved_tokens`; the
+`apex_serving_prefix_cached_tokens` gauge tracks store occupancy; and
+`prefix_caching=None` (the default) leaves every serving path
+byte-for-byte untouched.  `bench.py`'s `serving_prefix` block records
+the measured ≥ 2× aggregate prefill throughput on a shared-prompt
+fleet and the no-regression bar without overlap (asserted against
+the harness's own measured noise floor — capture is copy-based, so
+its true cost is real but sub-noise at bench scale).
 
 Watch a training job live — the supervisor, checkpoint manager, and
 serving scheduler already publish into the default metrics registry

@@ -287,15 +287,35 @@ class LlamaAttention(nn.Module):
 
         if kv_cache is not None:
             from apex_tpu.serving import kv_cache as kvc
+            from apex_tpu.serving import paged_kv_cache as pkv
 
+            # the cache's pytree type is a trace-time constant, so this
+            # branch costs nothing at runtime: a paged cache writes
+            # through the slot's block table and reads the same
+            # [max_len]-extent view back out of the pool via a
+            # fixed-extent gather — identical values at every unmasked
+            # position, identical reduction extents, hence bit-identical
+            # logits (the dense-vs-paged parity contract)
+            paged = isinstance(kv_cache, pkv.PagedKVCache)
             if decode:
                 # append this token per slot, then attend over the whole
                 # masked cache (post-rope K, like the uncached path sees)
-                kv_cache = kvc.append_token(
-                    kv_cache, layer_idx, k[0], v[0],
-                    jnp.asarray(position))
-                kc = kv_cache.k[layer_idx].astype(q.dtype)  # [b,max,nkv,hd]
-                vc = kv_cache.v[layer_idx].astype(q.dtype)
+                if paged:
+                    # inactive lanes arrive as position -1: a paged
+                    # table has no private masked scratch rows, so
+                    # their writes are dropped instead of routed
+                    kv_cache = pkv.paged_append(
+                        kv_cache, layer_idx, k[0], v[0],
+                        jnp.asarray(position))
+                    kc, vc = pkv.decode_view(kv_cache, layer_idx)
+                    kc = kc.astype(q.dtype)         # [b, max, nkv, hd]
+                    vc = vc.astype(q.dtype)
+                else:
+                    kv_cache = kvc.append_token(
+                        kv_cache, layer_idx, k[0], v[0],
+                        jnp.asarray(position))
+                    kc = kv_cache.k[layer_idx].astype(q.dtype)  # [b,max,nkv,hd]
+                    vc = kv_cache.v[layer_idx].astype(q.dtype)
                 if nkv != nq:
                     rep = nq // nkv
                     kc = jnp.repeat(kc, rep, axis=2)
@@ -314,15 +334,23 @@ class LlamaAttention(nn.Module):
                     raise ValueError(
                         f"prefill expects one slot per call (b=1), got "
                         f"b={b}")
-                kv_cache = kvc.prefill_into_slot(
-                    kv_cache, layer_idx, slot, k[:, 0], v[:, 0],
-                    start=offset)
-                kc = jax.lax.dynamic_index_in_dim(
-                    kv_cache.k[layer_idx], jnp.asarray(slot, jnp.int32),
-                    axis=0, keepdims=False).astype(q.dtype)  # [max,nkv,hd]
-                vc = jax.lax.dynamic_index_in_dim(
-                    kv_cache.v[layer_idx], jnp.asarray(slot, jnp.int32),
-                    axis=0, keepdims=False).astype(q.dtype)
+                if paged:
+                    kv_cache = pkv.paged_prefill_write(
+                        kv_cache, layer_idx, slot, k[:, 0], v[:, 0],
+                        start=offset)
+                    kc, vc = pkv.prefill_view(kv_cache, layer_idx, slot)
+                    kc = kc.astype(q.dtype)         # [max, nkv, hd]
+                    vc = vc.astype(q.dtype)
+                else:
+                    kv_cache = kvc.prefill_into_slot(
+                        kv_cache, layer_idx, slot, k[:, 0], v[:, 0],
+                        start=offset)
+                    kc = jax.lax.dynamic_index_in_dim(
+                        kv_cache.k[layer_idx], jnp.asarray(slot, jnp.int32),
+                        axis=0, keepdims=False).astype(q.dtype)  # [max,nkv,hd]
+                    vc = jax.lax.dynamic_index_in_dim(
+                        kv_cache.v[layer_idx], jnp.asarray(slot, jnp.int32),
+                        axis=0, keepdims=False).astype(q.dtype)
                 if nkv != nq:
                     rep = nq // nkv
                     kc = jnp.repeat(kc, rep, axis=1)

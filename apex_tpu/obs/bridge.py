@@ -132,6 +132,18 @@ SERVING_SPEC_SPEEDUP = metrics.gauge(
     "apex_serving_spec_speedup",
     "tokens emitted per verify dispatch on the speculative path "
     "(1.0 == plain decode's one token per dispatch)")
+SERVING_BLOCK_POOL_UTILIZATION = metrics.gauge(
+    "apex_serving_block_pool_utilization",
+    "allocated KV pool blocks / allocatable blocks (paged cache; "
+    "refreshed per scheduler step while a paged engine serves)")
+SERVING_BLOCK_ALIAS_HITS = metrics.counter(
+    "apex_serving_block_alias_hits_total",
+    "prefix-cache blocks reused by block-table aliasing — zero-copy "
+    "hits: no K/V moved, the block just gained a reference")
+SERVING_BLOCK_COW = metrics.counter(
+    "apex_serving_block_cow_total",
+    "copy-on-write block copies (a write targeted a block whose "
+    "refcount exceeded one — sharers stay bit-isolated)")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -218,6 +230,18 @@ def _on_serving_prefix_miss(event: dict) -> None:
     SERVING_PREFIX_MISSES.inc()
 
 
+def _on_serving_block_alias(event: dict) -> None:
+    blocks = _measurement(event, "blocks")
+    if blocks is not None and blocks > 0:
+        SERVING_BLOCK_ALIAS_HITS.inc(blocks)
+
+
+def _on_serving_block_cow(event: dict) -> None:
+    blocks = _measurement(event, "blocks")
+    if blocks is not None and blocks > 0:
+        SERVING_BLOCK_COW.inc(blocks)
+
+
 def _on_serving_request_finished(event: dict) -> None:
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
@@ -240,6 +264,8 @@ _HANDLERS = {
     "serving_prefill_chunk": _on_serving_prefill_chunk,
     "serving_prefix_hit": _on_serving_prefix_hit,
     "serving_prefix_miss": _on_serving_prefix_miss,
+    "serving_block_alias": _on_serving_block_alias,
+    "serving_block_cow": _on_serving_block_cow,
     "serving_spec_verify": _on_serving_spec_verify,
     "serving_request_finished": _on_serving_request_finished,
 }

@@ -11,6 +11,18 @@ head matmul), its amp policies, and its resilience checkpoints:
   prefill chunks, vmapped ``lax.dynamic_update_slice`` for decode
   appends): one static shape for every decode step, zero recompiles
   after warmup.
+- :mod:`.paged_kv_cache` — the opt-in **paged** layout
+  (``DecodeEngine(..., paged=PagedCacheConfig(...))``): a global pool
+  of fixed-size K/V blocks (``[layers, num_blocks, block_size,
+  kv_heads, head_dim]``) read through per-slot block tables by
+  fixed-extent gathers at the same ``-1e30`` mask convention — greedy
+  streams stay **bit-identical** to the dense engine while memory
+  scales with *used* tokens (several times more concurrent streams
+  per byte; admission prices blocks).  Prefix-cache hits become
+  zero-copy block-table aliasing with refcounts
+  (``DecodeEngine.alias_prefix``), ``DecodeEngine.fork_slot``
+  branches a live stream the same way, and copy-on-write keeps every
+  sharer of a block bit-isolated.
 - :mod:`.engine` — :class:`DecodeEngine`: length-bucketed **chunked
   prefill** (a prompt chunk is padded to the smallest covering
   power-of-two bucket, so a short prompt costs a short dispatch and
@@ -89,6 +101,13 @@ from apex_tpu.serving.kv_cache import (
     valid_token_mask,
     write_slot_region,
 )
+from apex_tpu.serving.paged_kv_cache import (
+    BlockPoolExhausted,
+    PagedCacheConfig,
+    PagedCacheManager,
+    PagedKVCache,
+    init_paged_cache,
+)
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from apex_tpu.serving.scheduler import (
     ContinuousBatchingScheduler,
@@ -108,6 +127,11 @@ __all__ = [
     "release_slot",
     "valid_token_mask",
     "write_slot_region",
+    "BlockPoolExhausted",
+    "PagedCacheConfig",
+    "PagedCacheManager",
+    "PagedKVCache",
+    "init_paged_cache",
     "PrefixCache",
     "PrefixCacheConfig",
     "DecodeEngine",

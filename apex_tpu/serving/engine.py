@@ -63,13 +63,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from apex_tpu._logging import get_logger
+from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.serving.kv_cache import (
     KVCache,
     commit_slot_length,
     init_cache,
     release_slot,
     write_slot_region,
+)
+from apex_tpu.serving.paged_kv_cache import (
+    PagedCacheConfig,
+    PagedCacheManager,
+    PagedKVCache,
+    blocks_per_slot,
+    init_paged_cache,
 )
 from apex_tpu.utils.compat import compile_count
 
@@ -189,7 +196,8 @@ class DecodeEngine:
                  max_len: int = 512, prefill_len: int = 64,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  draft_buckets: Optional[Sequence[int]] = None,
-                 cache_dtype=None):
+                 cache_dtype=None,
+                 paged: Optional[PagedCacheConfig] = None):
         if prefill_len < 2:
             raise ValueError("prefill_len must be >= 2 (a length-1 "
                              "prefill is indistinguishable from a decode "
@@ -245,16 +253,43 @@ class DecodeEngine:
                       if hasattr(l, "dtype")
                       and jnp.issubdtype(l.dtype, jnp.floating)]
             cache_dtype = floats[0] if floats else jnp.float32
+        # opt-in paged layout: a global block pool + per-slot block
+        # tables, host-managed by a PagedCacheManager (allocation,
+        # refcounts, CoW planning).  None (the default) keeps the dense
+        # per-slot cache byte-for-byte as before — every PR-4..9
+        # guarantee stays provable side by side.
+        self._paged_cfg = paged
+        self._pager: Optional[PagedCacheManager] = None
+        if paged is not None:
+            bs = int(paged.block_size)
+            if bs > max_len:
+                raise ValueError(
+                    f"paged block_size {bs} exceeds max_len {max_len}")
+            nblk = paged.num_blocks
+            if nblk is None:
+                # dense-capacity parity: every slot can still fill to
+                # max_len with zero sharing (plus the null block)
+                nblk = slots * blocks_per_slot(max_len, bs) + 1
+            self._pager = PagedCacheManager(
+                slots=slots, max_len=max_len, block_size=bs,
+                num_blocks=int(nblk))
         # commit the fresh cache to its device up front: the first
         # prefill otherwise sees UNCOMMITTED zeros while every later
         # call sees the jit output's committed placement — same trace,
         # but pjit specializes a SECOND executable for the changed
         # placement, and the "compiles bounded by the bucket table"
         # contract would be off by one (environment-dependently)
-        self._cache = jax.device_put(
-            init_cache(model.config, slots=slots, max_len=max_len,
-                       dtype=cache_dtype),
-            jax.local_devices()[0])
+        if self._pager is not None:
+            fresh = init_paged_cache(
+                model.config, slots=slots, max_len=max_len,
+                block_size=self._pager.block_size,
+                num_blocks=self._pager.num_blocks, dtype=cache_dtype)
+            self._pager.consume_dirty()     # device holds this snapshot
+        else:
+            fresh = init_cache(model.config, slots=slots, max_len=max_len,
+                               dtype=cache_dtype)
+        self._device = jax.local_devices()[0]
+        self._cache = jax.device_put(fresh, self._device)
         # slots whose K/V arrived via restore_prefix (slot -> restored
         # token count): the ONLY slots prefill() accepts a nonzero
         # resume offset for — an arbitrary occupied slot is still
@@ -285,8 +320,20 @@ class DecodeEngine:
         def _decode(params, cache, tokens, active):
             # tokens [slots] int32 (last sampled per slot); active [slots]
             # bool — inactive lanes still compute (shape stability) but
-            # never advance their length, so their writes are unreadable
-            position = cache.lengths
+            # never advance their length, so their writes are unreadable.
+            # Dense lanes park inactive writes in their own masked rows;
+            # a paged table has no private scratch (a stale entry could
+            # route the row into another stream's live block), so
+            # inactive lanes carry the -1 sentinel and their writes are
+            # DROPPED by the paged append's drop-safe scatter.  The
+            # branch is on the cache's pytree type — a trace-time
+            # constant, so each engine still compiles exactly one
+            # decode program and the dense trace is untouched.
+            if isinstance(cache, PagedKVCache):
+                position = jnp.where(active, cache.lengths,
+                                     jnp.int32(-1))
+            else:
+                position = cache.lengths
             logits, cache = model.apply(params, tokens[:, None],
                                         kv_cache=cache, position=position)
             cache = dataclasses.replace(
@@ -332,6 +379,24 @@ class DecodeEngine:
             cache = write_slot_region(cache, slot, start, k_blk, v_blk)
             return commit_slot_length(cache, slot, start + length)
 
+        def _cow(cache, src, dst):
+            # copy-on-write block copy: pool block src -> dst across
+            # every layer, ONE compiled program for every (src, dst)
+            # pair (both traced scalars).  Runs BEFORE the write that
+            # needed it, so the writer lands on a private copy while
+            # the sharers keep the original bytes — bit-isolation by
+            # construction.
+            s = jnp.asarray(src, jnp.int32)
+            d = jnp.asarray(dst, jnp.int32)
+            k_blk = lax.dynamic_index_in_dim(cache.k, s, axis=1,
+                                             keepdims=False)
+            v_blk = lax.dynamic_index_in_dim(cache.v, s, axis=1,
+                                             keepdims=False)
+            return dataclasses.replace(
+                cache,
+                k=cache.k.at[:, d].set(k_blk),
+                v=cache.v.at[:, d].set(v_blk))
+
         def _read(cache, slot, start, *, n):
             # the traced-start twin of kv_cache.read_slot_region (same
             # row gather; the module primitive takes host ints while a
@@ -349,6 +414,7 @@ class DecodeEngine:
         self._decode = jax.jit(_decode, donate_argnums=(1,))
         self._verify = jax.jit(_verify, donate_argnums=(1,))
         self._restore = jax.jit(_restore, donate_argnums=(0,))
+        self._cow = jax.jit(_cow, donate_argnums=(0,))
         # NOT donated: a region read must leave the cache intact, and
         # its outputs are fresh owned buffers the prefix cache keeps
         # alive across later (donating) engine calls
@@ -385,11 +451,17 @@ class DecodeEngine:
             raise ValueError(f"slot {slot} out of range [0, {self.slots})")
 
     def release(self, slot: int) -> None:
-        """Evict a slot (O(1)); its bytes stay masked until overwritten."""
+        """Evict a slot (O(1)); its bytes stay masked until overwritten.
+        Paged engines also drop the slot's block references — blocks
+        shared with a prefix-cache entry or another slot survive; the
+        rest return to the pool."""
         self._check_slot(slot)
         self._cache = release_slot(self._cache, slot)
         self._lengths_host[slot] = 0
         self._restored.pop(slot, None)
+        if self._pager is not None:
+            self._pager.release(slot)
+            self._flush_tables()
 
     def reset(self) -> None:
         """Free every slot (keeps compiled programs and allocations)."""
@@ -397,6 +469,169 @@ class DecodeEngine:
             self._cache, lengths=jnp.zeros((self.slots,), jnp.int32))
         self._lengths_host[:] = 0
         self._restored.clear()
+        if self._pager is not None:
+            for slot in range(self.slots):
+                self._pager.release(slot)
+            self._flush_tables()
+
+    # ---- paged-cache state (no-ops / None on dense engines) --------------
+    @property
+    def paged(self) -> Optional[PagedCacheConfig]:
+        """The paged-cache config, or ``None`` on a dense engine."""
+        return self._paged_cfg
+
+    @property
+    def block_pool(self) -> Optional[PagedCacheManager]:
+        """The host block manager (allocation, refcounts, tables) —
+        ``None`` on a dense engine."""
+        return self._pager
+
+    @property
+    def block_size(self) -> Optional[int]:
+        return None if self._pager is None else self._pager.block_size
+
+    def free_blocks(self) -> Optional[int]:
+        """Unallocated pool blocks (``None`` on a dense engine) — the
+        admission-pricing number."""
+        return None if self._pager is None else self._pager.free_blocks
+
+    def block_pool_utilization(self) -> float:
+        """Allocated pool blocks / allocatable blocks in ``[0, 1]``
+        (0.0 on a dense engine) — feeds the
+        ``apex_serving_block_pool_utilization`` gauge."""
+        return 0.0 if self._pager is None else self._pager.utilization
+
+    def slot_block_ids(self, slot: int) -> list[int]:
+        """The pool block ids backing a slot, in token order — what a
+        paged prefix cache captures (by reference, zero-copy)."""
+        self._check_slot(slot)
+        if self._pager is None:
+            raise ValueError("slot_block_ids on a dense engine — "
+                             "construct with paged=PagedCacheConfig(...)")
+        return self._pager.slot_block_ids(slot)
+
+    def block_stats(self) -> dict:
+        """Cumulative pool accounting (alloc/free/CoW/alias counts) —
+        empty on a dense engine."""
+        return {} if self._pager is None else self._pager.stats()
+
+    def set_block_reclaim(self, callback) -> None:
+        """Install the pool's last-resort reclaim hook
+        (``(n_blocks) -> freed``), consulted once before an allocation
+        raises :class:`~apex_tpu.serving.paged_kv_cache.BlockPoolExhausted`
+        — the scheduler wires prefix-cache eviction here."""
+        if self._pager is None:
+            raise ValueError("set_block_reclaim on a dense engine")
+        self._pager.reclaim = callback
+
+    def cow_compiles(self) -> int:
+        """Number of distinct compiles of the copy-on-write block copy
+        (<= 1: src/dst are traced scalars).  Zero until the first CoW —
+        the witness that unshared workloads never pay the program."""
+        return compile_count(self._cow)
+
+    def _flush_tables(self, *, with_lengths: bool = False) -> None:
+        """Install the host table mirror on the device cache — one
+        small transfer, only when allocation actually changed (the
+        common within-block decode step flushes nothing).  With
+        ``with_lengths`` the committed-length mirror travels in the
+        SAME functional replace (alias/fork commit a table and a
+        length together — the zero-copy dispatch witness is that this
+        is the call's only device traffic)."""
+        if self._pager is not None and self._pager.consume_dirty():
+            # committed placement on purpose: an uncommitted jnp array
+            # here would make pjit specialize a SECOND executable for
+            # the changed placement, breaking the one-decode-compile
+            # contract (same trap as the init-time device_put)
+            kwargs = {"tables": jax.device_put(self._pager.table_snapshot(),
+                                               self._device)}
+            if with_lengths:
+                kwargs["lengths"] = jax.device_put(
+                    self._lengths_host.astype(np.int32), self._device)
+            self._cache = dataclasses.replace(self._cache, **kwargs)
+        elif with_lengths:
+            self._cache = dataclasses.replace(
+                self._cache,
+                lengths=jax.device_put(self._lengths_host.astype(np.int32),
+                                       self._device))
+
+    def _ensure_paged(self, writes) -> None:
+        """Pre-dispatch allocation for a batch of write spans
+        ``(slot, start, stop)``: allocate table entries, run the CoW
+        copies any shared block needs (one compiled program per pair,
+        BEFORE the write lands), and flush the table mirror once for
+        the whole batch — the per-step device cost is bounded by
+        [0 table flushes on within-block steps, 1 otherwise] plus one
+        tiny copy per CoW'd block."""
+        if self._pager is None:
+            return
+        pairs = []
+        for slot, start, stop in writes:
+            pairs.extend(self._pager.ensure(slot, start, stop))
+        for src, dst in pairs:
+            self._cache = self._cow(self._cache, np.int32(src),
+                                    np.int32(dst))
+        if pairs:
+            emit_event("serving_block_cow", blocks=len(pairs))
+        self._flush_tables()
+
+    def alias_prefix(self, slot: int, block_ids: Sequence[int],
+                     length: int) -> None:
+        """Zero-copy prefix reuse: point a free slot's block table at
+        already-resident shared blocks and commit ``length`` valid
+        tokens — the paged replacement for :meth:`restore_prefix`.
+        No K/V bytes move and no compiled program runs (the whole call
+        is host bookkeeping plus one table/length snapshot transfer);
+        each block just gains a reference, and the slot's later writes
+        into any shared block copy-on-write first.  After the call
+        :meth:`prefill`/``prefill_chunk`` may resume the prompt at
+        offset ``length``, exactly like a restore."""
+        self._check_slot(slot)
+        if self._pager is None:
+            raise ValueError("alias_prefix on a dense engine — use "
+                             "restore_prefix (copy-based) instead")
+        if self._lengths_host[slot]:
+            raise ValueError(
+                f"slot {slot} is occupied ({self._lengths_host[slot]} "
+                f"tokens); release() it before aliasing into it")
+        length = int(length)
+        if not 1 <= length <= self.max_len - 1:
+            raise ValueError(
+                f"aliased prefix of {length} tokens not in [1, "
+                f"{self.max_len - 1}] (the resume chunk must still fit)")
+        bs = self._pager.block_size
+        want = blocks_per_slot(length, bs)
+        if len(block_ids) != want:
+            raise ValueError(
+                f"{len(block_ids)} blocks cannot hold exactly {length} "
+                f"tokens at block_size {bs} (want {want})")
+        self._pager.alias(slot, block_ids, length)
+        self._lengths_host[slot] = length
+        self._restored[slot] = length
+        self._flush_tables(with_lengths=True)
+
+    def fork_slot(self, src: int, dst: int) -> None:
+        """Branch a live stream: share every block of ``src`` into free
+        slot ``dst`` (zero-copy — refcounts only) and commit the same
+        length.  Both streams may keep decoding; the first write either
+        side makes into a shared block — including the partial tail
+        block both are about to append into — triggers copy-on-write,
+        so the streams stay bit-isolated from that point on (the
+        parallel-sampling / n-best primitive)."""
+        self._check_slot(src)
+        self._check_slot(dst)
+        if self._pager is None:
+            raise ValueError("fork_slot on a dense engine — the dense "
+                             "layout has no shareable blocks")
+        if not self._lengths_host[src]:
+            raise ValueError(f"fork of empty slot {src}")
+        if self._lengths_host[dst]:
+            raise ValueError(
+                f"slot {dst} is occupied ({self._lengths_host[dst]} "
+                f"tokens); release() it before forking into it")
+        self._pager.fork(src, dst)
+        self._lengths_host[dst] = self._lengths_host[src]
+        self._flush_tables(with_lengths=True)
 
     def decode_compiles(self) -> int:
         """Number of distinct compiles of the decode step (1 == the
@@ -473,9 +708,17 @@ class DecodeEngine:
                 f"max_len {self.max_len}")
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :n] = np.asarray(tokens, np.int32)
+        # paged: allocate/CoW the REAL rows' blocks before the write
+        # lands (bucket-padding rows past the frontier route to the
+        # null table entry and are dropped by the scatter)
+        self._ensure_paged([(slot, offset, offset + n)])
+        # np scalars, not jnp: a jnp.int32() wrapper costs a device_put
+        # (~35us) EACH on the dispatching host thread — three of them
+        # tripled this call's host cost (see PERF_NOTES; same move as
+        # read_region)
         logits, self._cache = self._prefill(
-            self.params, self._cache, jnp.asarray(ids),
-            jnp.int32(slot), jnp.int32(offset), jnp.int32(n))
+            self.params, self._cache, ids,
+            np.int32(slot), np.int32(offset), np.int32(n))
         self._lengths_host[slot] = offset + n
         return logits
 
@@ -551,6 +794,11 @@ class DecodeEngine:
         blocks into one span read, so its compiles are bounded by
         ``ceil(prefill_len / block_size)`` distinct extents."""
         self._check_slot(slot)
+        if self._pager is not None:
+            raise ValueError(
+                "read_region on a paged engine — prefix capture is "
+                "by-reference there (slot_block_ids + refcounts), not "
+                "by copy")
         start, stop = int(start), int(stop)
         if not 0 <= start < stop <= int(self._lengths_host[slot]):
             raise ValueError(
@@ -581,6 +829,10 @@ class DecodeEngine:
         compute the next-token logits the stream needs.
         """
         self._check_slot(slot)
+        if self._pager is not None:
+            raise ValueError(
+                "restore_prefix on a paged engine — hits alias shared "
+                "blocks zero-copy (alias_prefix), never write K/V back")
         if self._lengths_host[slot]:
             raise ValueError(
                 f"slot {slot} is occupied ({self._lengths_host[slot]} "
@@ -641,9 +893,17 @@ class DecodeEngine:
                 f"slots {np.flatnonzero(empty).tolist()} are active but "
                 f"never prefilled — a decode step would expose a garbage "
                 f"token as their whole context")
+        if self._pager is not None:
+            # one batched allocation pass for every active lane, ONE
+            # table flush at most (none at all on the (block_size-1)
+            # of block_size steps that cross no block boundary)
+            self._ensure_paged(
+                [(int(s), int(self._lengths_host[s]),
+                  int(self._lengths_host[s]) + 1)
+                 for s in np.flatnonzero(act)])
         logits, self._cache = self._decode(
             self.params, self._cache,
-            jnp.asarray(tokens, jnp.int32), jnp.asarray(act))
+            np.asarray(tokens, np.int32), act)
         self._lengths_host[act] += 1
         return logits
 
@@ -693,9 +953,13 @@ class DecodeEngine:
                 f"cache max_len {self.max_len}")
         ids = np.zeros((1, bucket + 1), np.int32)
         ids[0, :k + 1] = np.asarray(tokens, np.int32)
+        # paged: cover the pending token + the whole real draft; a
+        # rollback leaves the surplus blocks owned by the slot (refs
+        # untouched), so the re-decode over them re-allocates nothing
+        self._ensure_paged([(slot, offset, offset + k + 1)])
         greedy, rows, accepted, self._cache = self._verify(
-            self.params, self._cache, jnp.asarray(ids), jnp.int32(slot),
-            jnp.int32(offset), jnp.int32(k + 1))
+            self.params, self._cache, ids, np.int32(slot),
+            np.int32(offset), np.int32(k + 1))
         a = int(accepted)
         self._lengths_host[slot] = offset + a + 1
         return a, np.asarray(greedy), rows

@@ -116,9 +116,10 @@ class _Entry:
     chain: str                   # this entry's chain hash
     parent: str                  # parent block's chain hash (or root)
     tokens: Tuple[int, ...]      # the block's tokens (len == block_size)
-    span: _Span                  # shared captured buffers
-    lo: int                      # this block's row offset inside span
+    span: Optional[_Span] = None  # shared captured buffers (dense mode)
+    lo: int = 0                  # this block's row offset inside span
     refs: int = 0                # live pins; > 0 == never evictable
+    block_id: Optional[int] = None  # pool block id (paged mode)
 
 
 class PrefixCache:
@@ -136,13 +137,22 @@ class PrefixCache:
 
     ROOT = _ROOT
 
-    def __init__(self, *, block_size: int, max_tokens: int):
+    def __init__(self, *, block_size: int, max_tokens: int,
+                 pool=None, bytes_per_block: int = 0):
         if block_size < 1:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if max_tokens < 1:
             raise ValueError(f"max_tokens must be >= 1, got {max_tokens}")
         self.block_size = int(block_size)
         self.max_tokens = int(max_tokens)
+        # paged mode: entries hold pool block IDS (captured by
+        # reference via put_block_ids — the cache holds one allocator
+        # reference per entry, dropped at eviction so the pool block
+        # frees once no slot shares it).  ``pool`` is the engine's
+        # PagedCacheManager (or anything with ref/deref);
+        # ``bytes_per_block`` feeds the honest cached_bytes figure.
+        self._pool = pool
+        self._bytes_per_block = int(bytes_per_block)
         # LRU order IS the dict order: touch == move_to_end, eviction
         # scans from the oldest end for the first evictable entry —
         # O(1) in the common case instead of a full min() scan of a
@@ -186,7 +196,12 @@ class PrefixCache:
         figure: a span stays allocated until its LAST entry is evicted,
         so this can exceed ``cached_tokens``-worth of bytes while a
         partially evicted span survives (bounded by one chunk's rows
-        per surviving span)."""
+        per surviving span).  In paged mode this is entries *
+        bytes_per_block — the pool bytes the cache's references PIN;
+        a block also referenced by a live slot costs no *extra* memory
+        beyond this figure (the reference is the whole point)."""
+        if self._pool is not None:
+            return len(self._entries) * self._bytes_per_block
         return self._span_bytes
 
     def stats(self) -> Dict[str, int]:
@@ -265,6 +280,62 @@ class PrefixCache:
         out = self.put_blocks(parent, [tokens], k, v)
         return out[0] if out else None
 
+    def put_block_ids(self, parent: str,
+                      blocks: Sequence[Sequence[int]],
+                      block_ids: Sequence[int]) -> List[_Entry]:
+        """Paged-mode insert: capture consecutive completed blocks **by
+        reference** — each new entry records the pool block id the
+        prompt's K/V already lives in and takes one allocator reference
+        (zero device reads, zero copies; the owning slot keeps its own
+        reference and both decay independently).  Same chain semantics
+        as :meth:`put_blocks`: idempotent per block (an existing entry
+        is touched and returned — its block stays THE shared copy; the
+        caller's duplicate block simply frees when its slot releases),
+        stops at the first orphaned parent, and runs the LRU eviction
+        pass with this call's own fresh entries protected."""
+        if self._pool is None:
+            raise ValueError("put_block_ids on a span-mode cache — "
+                             "construct with pool=... (a paged engine's "
+                             "block_pool)")
+        if len(block_ids) != len(blocks):
+            raise ValueError(
+                f"{len(block_ids)} block ids for {len(blocks)} blocks")
+        out: List[_Entry] = []
+        created: List[_Entry] = []
+        for block, bid in zip(blocks, block_ids):
+            tokens = tuple(map(int, block))
+            if len(tokens) != self.block_size:
+                raise ValueError(
+                    f"block of {len(tokens)} tokens != block_size "
+                    f"{self.block_size} — only whole blocks are "
+                    f"hashable")
+            chain = self.chain_hash(parent, tokens)
+            entry = self._entries.get(chain)
+            if entry is None:
+                if parent != _ROOT and parent not in self._entries:
+                    self._refused += 1
+                    logger.debug("prefix put refused: parent %.12s "
+                                 "evicted", parent)
+                    break
+                self._pool.ref([int(bid)])
+                entry = _Entry(chain=chain, parent=parent, tokens=tokens,
+                               block_id=int(bid))
+                self._entries[chain] = entry
+                self._children.setdefault(parent, set()).add(chain)
+                self._inserted += 1
+                created.append(entry)
+            self._touch(entry)
+            out.append(entry)
+            parent = chain
+        for entry in created:       # protected through the pass below
+            entry.refs += 1
+        try:
+            self._evict_to_budget()
+        finally:
+            for entry in created:
+                entry.refs -= 1
+        return out
+
     def put_blocks(self, parent: str, blocks: Sequence[Sequence[int]],
                    k_span, v_span) -> List[_Entry]:
         """Insert consecutive captured blocks sharing ONE span buffer
@@ -286,6 +357,9 @@ class PrefixCache:
         children the store may transiently exceed the budget rather
         than corrupt a chain a live slot is feeding.
         """
+        if self._pool is not None:
+            raise ValueError("put_blocks on a paged cache — capture is "
+                             "by reference there (put_block_ids)")
         rows = int(k_span.shape[1])
         if rows != len(blocks) * self.block_size:
             raise ValueError(
@@ -349,6 +423,10 @@ class PrefixCache:
         chunk costs one slice — not one per block."""
         if not entries:
             raise ValueError("gather_kv of an empty chain")
+        if any(e.span is None for e in entries):
+            raise ValueError("gather_kv of paged (by-reference) entries "
+                             "— alias their block_ids instead of "
+                             "materializing K/V")
         parts_k, parts_v = [], []
         i = 0
         while i < len(entries):
@@ -375,6 +453,30 @@ class PrefixCache:
     def _evictable(self, entry: _Entry) -> bool:
         return not entry.refs and not self._children.get(entry.chain)
 
+    def _drop(self, victim: _Entry) -> int:
+        """Remove one entry and release its payload: span accounting in
+        dense mode, one allocator dereference in paged mode.  Returns
+        pool blocks actually freed (0 unless paged and no slot still
+        shares the block)."""
+        del self._entries[victim.chain]
+        siblings = self._children.get(victim.parent)
+        if siblings is not None:
+            siblings.discard(victim.chain)
+            if not siblings:
+                del self._children[victim.parent]
+        self._children.pop(victim.chain, None)
+        freed = 0
+        if victim.block_id is not None:
+            freed = self._pool.deref([victim.block_id])
+        else:
+            victim.span.live -= 1
+            if victim.span.live == 0:
+                # last entry of the span gone: its device buffers are
+                # droppable now (nothing else references them)
+                self._span_bytes -= victim.span.nbytes
+        self._evicted += 1
+        return freed
+
     def _evict_to_budget(self) -> None:
         while self.cached_tokens > self.max_tokens:
             victim = next(
@@ -387,19 +489,43 @@ class PrefixCache:
                     "prefix cache over budget (%d > %d tokens) with no "
                     "evictable entry", self.cached_tokens, self.max_tokens)
                 return
-            del self._entries[victim.chain]
-            siblings = self._children.get(victim.parent)
-            if siblings is not None:
-                siblings.discard(victim.chain)
-                if not siblings:
-                    del self._children[victim.parent]
-            self._children.pop(victim.chain, None)
-            victim.span.live -= 1
-            if victim.span.live == 0:
-                # last entry of the span gone: its device buffers are
-                # droppable now (nothing else references them)
-                self._span_bytes -= victim.span.nbytes
-            self._evicted += 1
+            self._drop(victim)
+
+    # ---- paged-mode reclaim ----------------------------------------------
+    def evictable_blocks(self) -> int:
+        """Blocks eviction could return to the pool RIGHT NOW: unpinned
+        childless entries whose pool block nothing else references (a
+        shared block survives its entry's eviction until every aliasing
+        slot releases, so counting it would let admission overcommit —
+        the gate's reservation math needs a pessimistic floor, and
+        deeper chain links freed by cascading evictions only make the
+        true count higher).  Span-mode entries always free with their
+        entry."""
+        return sum(
+            1 for e in self._entries.values()
+            if self._evictable(e) and (
+                self._pool is None
+                or self._pool.refcount(e.block_id) == 1))
+
+    def evict_blocks(self, n_blocks: int) -> int:
+        """Free pool blocks under memory pressure by evicting LRU
+        unpinned leaf entries until ``n_blocks`` blocks actually
+        returned to the pool (or nothing evictable remains) — the
+        block-granular backpressure hook a paged engine's allocator
+        calls before raising ``BlockPoolExhausted``.  Returns blocks
+        freed; pinned chains are never touched (a live prefill's chain
+        beats new admissions)."""
+        if self._pool is None:
+            raise ValueError("evict_blocks on a span-mode cache")
+        freed = 0
+        while freed < n_blocks:
+            victim = next(
+                (e for e in self._entries.values() if self._evictable(e)),
+                None)
+            if victim is None:
+                break
+            freed += self._drop(victim)
+        return freed
 
     def clear(self) -> None:
         """Drop every entry (refuses while any entry is pinned — a live
@@ -410,6 +536,9 @@ class PrefixCache:
                 f"clear() with {len(pinned)} pinned entr"
                 f"{'y' if len(pinned) == 1 else 'ies'} — release the "
                 f"live slots first")
+        if self._pool is not None:
+            self._pool.deref([e.block_id for e in self._entries.values()
+                              if e.block_id is not None])
         self._entries.clear()
         self._children.clear()
         self._span_bytes = 0

@@ -95,6 +95,7 @@ from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import DecodeEngine, request_key
+from apex_tpu.serving.paged_kv_cache import blocks_per_slot
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
@@ -206,23 +207,56 @@ class ContinuousBatchingScheduler:
         self.log_interval = max(1, int(log_interval))
         self.prefill_budget = int(prefill_budget)
         self.speculation = speculation
+        # paged engines price admission in POOL BLOCKS (memory scales
+        # with used tokens, not slots x max_len) and capture/reuse
+        # prefixes by block-table aliasing instead of K/V copies
+        self._paged = engine.paged is not None
         # cross-request prefix caching (opt-in; None == off leaves every
         # existing path byte-for-byte untouched — no events, no gauge
         # sets, no extra engine programs).  Block size defaults to the
         # engine's smallest prefill bucket so restored chains land on
-        # bucket-friendly chunk boundaries.
+        # bucket-friendly chunk boundaries; a paged engine pins it to
+        # the POOL block size (a cache entry IS a pool block there).
         self._prefix: Optional[PrefixCache] = None
+        self._reclaim_hook = None
         if prefix_caching is not None:
-            block = (prefix_caching.block_size
-                     if prefix_caching.block_size is not None
-                     else engine.prefill_buckets[0])
+            if self._paged:
+                block = engine.block_size
+                if (prefix_caching.block_size is not None
+                        and prefix_caching.block_size != block):
+                    raise ValueError(
+                        f"prefix block_size {prefix_caching.block_size} "
+                        f"!= the engine's pool block_size {block} — a "
+                        f"paged cache entry IS a pool block, so the "
+                        f"sizes cannot differ")
+            else:
+                block = (prefix_caching.block_size
+                         if prefix_caching.block_size is not None
+                         else engine.prefill_buckets[0])
             if block > engine.max_len - 1:
                 raise ValueError(
                     f"prefix block_size {block} cannot fit a "
                     f"max_len={engine.max_len} cache alongside the "
                     f"resume token")
-            self._prefix = PrefixCache(
-                block_size=block, max_tokens=prefix_caching.max_tokens)
+            if self._paged:
+                kshape = engine.cache.k.shape     # [L, nblk, bs, kvh, hd]
+                per_block = 2 * engine.cache.k.dtype.itemsize * int(
+                    np.prod((kshape[0],) + kshape[2:]))
+                self._prefix = PrefixCache(
+                    block_size=block,
+                    max_tokens=prefix_caching.max_tokens,
+                    pool=engine.block_pool, bytes_per_block=per_block)
+                # last-resort backpressure: an exhausted pool evicts
+                # unpinned cache entries before raising.  The bound
+                # method is STORED so close() can unhook exactly the
+                # hook it installed (a re-fetched bound method is a
+                # fresh object — identity would never match)
+                self._reclaim_hook = self._prefix.evict_blocks
+                engine.set_block_reclaim(self._reclaim_hook)
+            else:
+                self._prefix = PrefixCache(
+                    block_size=block,
+                    max_tokens=prefix_caching.max_tokens)
         self._clock = clock
         self._queue: deque[tuple[Request, float]] = deque()
         self._active: Dict[int, _Active] = {}
@@ -268,6 +302,20 @@ class ContinuousBatchingScheduler:
                 f"{request.max_new_tokens} needs "
                 f"{n + request.max_new_tokens - 1} cached positions, "
                 f"over cache max_len {self.engine.max_len}")
+        if self._paged:
+            # the paged analog of the max_len guard: a stream whose
+            # worst-case (zero-sharing) footprint exceeds the whole
+            # pool could stall every other stream before dying at
+            # BlockPoolExhausted — reject it at the door instead
+            bs = self.engine.block_size
+            need = blocks_per_slot(n + request.max_new_tokens - 1, bs)
+            usable = self.engine.block_pool.num_blocks - 1
+            if need > usable:
+                raise ValueError(
+                    f"{request.rid}: worst-case footprint of {need} "
+                    f"blocks (block_size {bs}) exceeds the whole pool "
+                    f"({usable} allocatable blocks) — raise num_blocks "
+                    f"or shrink the request")
         if len(self._queue) >= self.max_queue:
             raise QueueFull(f"queue at capacity ({self.max_queue})")
         self._queue.append((request, self._clock()))
@@ -321,6 +369,38 @@ class ContinuousBatchingScheduler:
                     if s not in self._active]
             if not free:
                 break
+            if self._paged and self._active:
+                # admission prices BLOCKS, not slots: hold the next
+                # request back while its WORST-CASE footprint — prompt
+                # plus every decode token it may still grow, the same
+                # ``n + max_new_tokens - 1`` rows submit() validates —
+                # couldn't be covered by free + cache-evictable blocks
+                # (live streams keep decoding and freeing; an idle
+                # system always admits so a too-tight pool fails loudly
+                # at allocation instead of deadlocking the queue).
+                # Blocks allocate lazily, so already-admitted streams
+                # RESERVE what they have yet to allocate; pricing the
+                # prompt alone would let concurrent streams pass this
+                # gate and then race each other into BlockPoolExhausted
+                # mid-DECODE — an uncatchable crash that loses every
+                # in-flight stream, not backpressure
+                request, _ = self._queue[0]
+                bs = self.engine.block_size
+                need = blocks_per_slot(
+                    len(request.prompt) + request.max_new_tokens - 1,
+                    bs)
+                reserved = 0
+                for st in self._active.values():
+                    rows = (len(st.request.prompt)
+                            + st.request.max_new_tokens - 1)
+                    owned = self.engine.block_pool.owned_blocks(
+                        st.slot)
+                    reserved += max(blocks_per_slot(rows, bs) - owned, 0)
+                avail = self.engine.free_blocks() - reserved + (
+                    self._prefix.evictable_blocks()
+                    if self._prefix is not None else 0)
+                if need > avail:
+                    break
             request, t_submit = self._queue.popleft()
             slot = free[0]
             # per-request draft state: greedy requests under an enabled
@@ -354,6 +434,30 @@ class ContinuousBatchingScheduler:
         enabled (``None`` otherwise) — introspection for tests/bench."""
         return self._prefix
 
+    def close(self) -> None:
+        """Tear down this scheduler's prefix cache: drop every entry
+        (on a paged engine that derefs the cached pool blocks) and
+        unhook the engine's block-reclaim callback.  REQUIRED before
+        building a new caching scheduler over the same engine — an
+        abandoned paged cache otherwise pins its blocks forever and
+        the allocator keeps reclaiming into the dead store.  Refuses
+        while work is in flight; idempotent once drained."""
+        if self._active or self._queue:
+            raise RuntimeError(
+                f"close() with {len(self._active)} active stream(s) and "
+                f"{len(self._queue)} queued request(s) — drain with "
+                f"run() first")
+        if self._prefix is not None:
+            self._prefix.clear()
+            if (self._paged and self.engine.block_pool.reclaim
+                    is self._reclaim_hook):
+                # unhook ONLY our own hook: a newer caching scheduler
+                # over the same engine may have re-wired reclaim to
+                # ITS cache — clearing that would silently disable
+                # its backpressure and turn pool pressure into
+                # BlockPoolExhausted despite reclaimable blocks
+                self.engine.set_block_reclaim(None)
+
     def _match_and_restore(self, st: _Active) -> None:
         """Admission-time prefix reuse: longest-chain match against the
         prompt, bucketed restore of the hit into the fresh slot, and a
@@ -370,9 +474,18 @@ class ContinuousBatchingScheduler:
                        prompt_tokens=len(request.prompt))
             return
         t0 = self._clock()
-        self.engine.restore_prefix(st.slot,
-                                   self._prefix.gather_kv(entries),
-                                   covered)
+        if self._paged:
+            # zero-copy hit: append the shared block ids to the fresh
+            # slot's table — no K/V bytes move, no compiled program
+            # runs; the whole restore dispatch family is gone
+            self.engine.alias_prefix(
+                st.slot, [e.block_id for e in entries], covered)
+            emit_event("serving_block_alias", rid=request.rid,
+                       blocks=len(entries), saved_tokens=covered)
+        else:
+            self.engine.restore_prefix(st.slot,
+                                       self._prefix.gather_kv(entries),
+                                       covered)
         dt = self._clock() - t0
         self._prefix.acquire(entries)
         st.pinned = list(entries)
@@ -417,6 +530,25 @@ class ContinuousBatchingScheduler:
             st.blocks_cached += 1
         missing = total - st.blocks_cached
         if missing <= 0:
+            return
+        if self._paged:
+            # 2a) paged capture is BY REFERENCE: the prompt's K/V
+            # already lives in pool blocks the slot's table names, so
+            # each missing block's entry just records its id and takes
+            # an allocator reference — zero device work, the
+            # zero-overlap overhead budget is pure host hashing
+            ids = self.engine.slot_block_ids(st.slot)
+            lo = st.blocks_cached
+            blocks = [st.request.prompt[(lo + i) * block:
+                                        (lo + i + 1) * block]
+                      for i in range(missing)]
+            entries = self._prefix.put_block_ids(
+                st.chain, blocks, ids[lo:lo + missing])
+            for entry in entries:
+                self._prefix.acquire([entry])
+                st.pinned.append(entry)
+                st.chain = entry.chain
+                st.blocks_cached += 1
             return
         # 2) batched snapshots of every missing block — a region read
         # whose span buffer the new entries share (the zero-overlap
@@ -671,6 +803,13 @@ class ContinuousBatchingScheduler:
             # stream byte-for-byte untouched (the identity contract)
             obs_bridge.SERVING_PREFIX_CACHED_TOKENS.set(
                 self._prefix.cached_tokens)
+        if self._paged:
+            # pool residency is the paged engine's capacity truth (the
+            # token-based cache_utilization above still reports the
+            # logical fill); only set when paged — the dense metric
+            # stream stays byte-for-byte untouched
+            obs_bridge.SERVING_BLOCK_POOL_UTILIZATION.set(
+                self.engine.block_pool_utilization())
         # every step like the others (a cheap host-side jit-cache read):
         # a scrape during the first log_interval steps must not read 0
         # for a gauge documented as "1 == shape-stable"

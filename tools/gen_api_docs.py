@@ -90,6 +90,7 @@ PAGES = {
     ]),
     "serving": ("Serving (KV-cached decode + continuous batching)", [
         "apex_tpu.serving", "apex_tpu.serving.kv_cache",
+        "apex_tpu.serving.paged_kv_cache",
         "apex_tpu.serving.engine", "apex_tpu.serving.draft",
         "apex_tpu.serving.prefix_cache",
         "apex_tpu.serving.scheduler", "apex_tpu.serving.weights",
@@ -442,6 +443,82 @@ is correctness, not approximation.  Bytes past `lengths` (chunk
 padding, evicted streams) are garbage by contract and unreadable by
 construction.
 
+## Paged KV cache (block pool + block tables)
+
+`DecodeEngine(..., paged=PagedCacheConfig(block_size=16,
+num_blocks=None))` swaps the dense per-slot buffer for a **global
+block pool** with per-slot **block tables**:
+
+```
+k, v:     [layers, num_blocks, block_size, kv_heads, head_dim]
+tables:   [slots, ceil(max_len / block_size)]  int32  # pool block ids
+lengths:  [slots]  int32
+```
+
+Block 0 is the reserved **null block** (finite zeros, never allocated):
+free and unallocated table entries point there, so a gather through any
+table state reads finite bytes — masked reads must never meet NaN,
+because `0 * NaN` would poison the PV matmul where masked probabilities
+are exact zeros.  Memory now scales with **used tokens**: a slot
+holding 40 tokens pins `ceil(40/16)` blocks, not `max_len` rows, so at
+a fixed byte budget several times more concurrent streams fit than the
+dense layout admits (the `serving_paged` bench block pins ≥ 4×), and
+admission prices **blocks**, with block-granular backpressure.  The
+scheduler's gate prices each stream's **worst-case footprint** —
+`ceil((prompt + max_new_tokens − 1) / block_size)` blocks, the same
+bound `submit()` validates — minus what the stream already owns, and
+holds the next request back until free + cache-evictable blocks cover
+it (evictability counted pessimistically: a cached block still shared
+by a live slot's table frees nothing when evicted).  Pricing prompts
+alone would admit streams whose *decode growth* later exhausts the
+pool — an uncatchable mid-run crash, not backpressure.  Direct engine
+users without the gate get the loud failure mode: `BlockPoolExhausted`
+raises — never clamps — after a last-resort prefix-cache reclaim
+pass.
+
+**Table semantics.** The host `PagedCacheManager` owns allocation,
+per-block refcounts, and the table mirror; the device `tables` array is
+a snapshot flushed (one small transfer) only on steps whose allocation
+changed — a decode step inside a block crosses no boundary and flushes
+nothing.  Writes go through drop-safe scatters: a row whose table entry
+is the null block (bucket padding past the allocated frontier), whose
+position is `-1` (an inactive decode lane — the dense cache parks those
+writes in the lane's own masked rows; a paged table has no private
+scratch, so they are dropped), or `>= max_len` redirects out of pool
+range and is dropped.  Unlike the dense cache, padding is never written
+at all — no stale table can route a garbage row into another stream's
+live block.
+
+**Aliasing and copy-on-write.** Every user of a block holds one
+refcount: the owning slot, each aliasing slot, each prefix-cache entry.
+A prefix hit **aliases**: `DecodeEngine.alias_prefix` appends the
+shared block ids to the fresh slot's table — zero device reads, zero
+K/V copies, zero compiled programs (the whole
+`read_region`/`restore_prefix` capture/restore dispatch family
+disappears; on a paged engine those methods *raise*).
+`DecodeEngine.fork_slot` shares a live stream's whole table the same
+way (the parallel-sampling branch point).  Any **write** into a block
+whose refcount exceeds one triggers **copy-on-write**: the writer gets
+a private copy (one compiled block-copy program, run before the write
+lands) and the sharers keep the original bytes — streams sharing a
+tail block stay bit-isolated both ways.  A block returns to the pool
+only when its last reference drops.
+
+**The exactness argument for gather-based reads.** Attention reads a
+slot's K/V as the fixed-extent gather
+`pool[table[slot]] → [max_len, kv_heads, head_dim]` — one static shape
+for every slot state.  Valid rows hold bit-for-bit the values the dense
+cache holds at the same positions (same writes, routed); rows past the
+committed length — whatever blocks they land in — are masked at the
+same exact `-1e30`, carrying exactly zero weight; and the reduction
+extents are identical to the dense read.  Same values, same extents,
+same op sequence ⇒ **bit-identical logits**: tier-1
+(`tests/test_serving_paged.py`) pins paged greedy streams f32-exact
+against the dense engine *and* the uncached shape-stable forward,
+across prefill, decode, speculation, and prefix hits.  The dense
+layout stays available (the `paged=None` default) so every guarantee
+remains provable side by side.
+
 ## The prefill bucket table
 
 `DecodeEngine(prefill_len=..., prefill_buckets=None)` derives a
@@ -577,27 +654,41 @@ counts).
   `len(prompt) - 1` tokens: the final prompt token is always
   recomputed, because the resume chunk must produce the next-token
   logits the first sampled token comes from.
-- **Capture** is deterministic and insert-on-miss: immediately after
-  the prefill chunk that completes a block, the scheduler snapshots
-  exactly the rows prefill wrote (`DecodeEngine.read_region` — a
-  fixed-extent gather into owned buffers; one dispatch covers all of
-  a chunk's new blocks, which share one *span* buffer and slice out
-  of it lazily on the hit path).
-- **Restore** (`DecodeEngine.restore_prefix`) writes the matched
-  chain back through the same per-row `mode="drop"` scatter prefill
-  uses (`kv_cache.write_slot_region`) in bucket-padded chunks —
-  restore compiles are bounded by the prefill bucket table
-  (`restore_compiles()`), and `prefill(slot, tokens, resume=n)`
-  resumes the prompt over the restored state (the offset-prefill
-  rejection is lifted ONLY for engine-verified restored slots).
+- **Hits are zero-copy on a paged engine.**  With
+  `paged=PagedCacheConfig(...)` the cache entry for a block records
+  the **pool block id** the prompt's K/V already lives in (capture is
+  by reference: `DecodeEngine.slot_block_ids` plus one allocator
+  refcount per entry — zero device reads, zero copies, pure host
+  hashing), and a hit **aliases**: `DecodeEngine.alias_prefix` appends
+  the shared ids to the fresh slot's table.  No K/V bytes move in
+  either direction and no compiled program runs — the copy-based
+  capture/restore dispatch cost below simply does not exist.  The
+  slot's later writes into a shared block copy-on-write first, so the
+  cached bytes are immutable while any entry references them.
+- **Capture on a dense engine** is deterministic and insert-on-miss:
+  immediately after the prefill chunk that completes a block, the
+  scheduler snapshots exactly the rows prefill wrote
+  (`DecodeEngine.read_region` — a fixed-extent gather into owned
+  buffers; one dispatch covers all of a chunk's new blocks, which
+  share one *span* buffer and slice out of it lazily on the hit path).
+- **Restore on a dense engine** (`DecodeEngine.restore_prefix`) writes
+  the matched chain back through the same per-row `mode="drop"`
+  scatter prefill uses (`kv_cache.write_slot_region`) in bucket-padded
+  chunks — restore compiles are bounded by the prefill bucket table
+  (`restore_compiles()`).  Either way, `prefill(slot, tokens,
+  resume=n)` resumes the prompt over the reused state (the
+  offset-prefill rejection is lifted ONLY for engine-verified
+  restored/aliased slots).
 - **The exactness argument**: the entry's bytes ARE prefill's output
-  for that exact token prefix, snapshotted; the restore writes them
-  back bit-for-bit; and the resumed chunk reads the whole masked
-  cache through the same fixed-extent attention as always.  Nothing
-  in the pipeline rounds, re-orders, or approximates — so a hit
-  changes *nothing*: logits, tokens, and greedy streams are
-  bit-identical to the cold path (tier-1 pins the full trajectory,
-  `tests/test_serving_prefix.py`).
+  for that exact token prefix — snapshotted and written back
+  bit-for-bit on the dense path, or *the very same physical block*
+  read through the table gather on the paged path — and the resumed
+  chunk reads the whole masked cache through the same fixed-extent
+  attention as always.  Nothing in the pipeline rounds, re-orders, or
+  approximates — so a hit changes *nothing*: logits, tokens, and
+  greedy streams are bit-identical to the cold path (tier-1 pins the
+  full trajectory, `tests/test_serving_prefix.py` dense,
+  `tests/test_serving_paged.py` paged).
 - **Eviction and memory accounting**: LRU under a configurable
   `max_tokens` budget, leaf-first along chains (a parent with live
   children is never evicted, so every cached chain stays reachable —
@@ -609,20 +700,33 @@ counts).
   `cached_bytes` reports live span buffers honestly — a span's bytes
   free only when its last block is evicted, so one surviving block
   can transiently pin up to a chunk's span.
+- **Lifecycle**: a caching scheduler owns its `PrefixCache` for the
+  engine's lifetime.  Before discarding one (e.g. building a fresh
+  caching scheduler over the same engine), call
+  `ContinuousBatchingScheduler.close()` — on a paged engine it derefs
+  every cached pool block and unhooks the allocator's reclaim
+  callback; an abandoned cache would pin its blocks forever and leave
+  the allocator reclaiming into a dead store.
 
 Telemetry: `serving_prefix_hit` / `serving_prefix_miss` events at
-admission (hits carry `saved_tokens` + restore wall time), feeding
-`apex_serving_prefix_{hit,miss}_total` and the
+admission (hits carry `saved_tokens` + restore/alias wall time),
+feeding `apex_serving_prefix_{hit,miss}_total` and the
 `apex_serving_prefix_saved_tokens` histogram, plus the
 `apex_serving_prefix_cached_tokens` gauge refreshed each scheduler
-step while caching is enabled.  `bench.py`'s `serving_prefix` block
-measures 8 requests sharing a long system prompt — warm-cache
-admissions ≥ 2× the cold pass on aggregate prefill tokens/s, and no
-regression on a zero-overlap workload *within the harness's own
-measured noise floor* (capture is copy-based, so its true
-cost is real but sub-noise — ~0.5–1% of a prefill-only drain at bench
-scale; a regression beyond the measured noise fails the bar), streams
-asserted token-identical, restore compiles bounded.
+step while caching is enabled.  A paged engine adds
+`serving_block_alias` (per hit; feeds
+`apex_serving_block_alias_hits_total`) and `serving_block_cow` (per
+copy-on-write pass; feeds `apex_serving_block_cow_total`) events, and
+the `apex_serving_block_pool_utilization` gauge.  `bench.py`'s
+`serving_prefix` block measures 8 requests sharing a long system
+prompt — warm-cache admissions ≥ 2× the cold pass on aggregate prefill
+tokens/s, and no regression on a zero-overlap workload *within the
+harness's own measured noise floor* (dense capture is copy-based, so
+its true cost is real but sub-noise — ~0.5–1% of a prefill-only drain
+at bench scale; a regression beyond the measured noise fails the bar),
+streams asserted token-identical, restore compiles bounded; the
+`serving_paged` block repeats the shared-prompt workload on a paged
+engine, where hits alias instead of copy.
 
 ## Determinism guarantees
 
@@ -747,6 +851,9 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_spec_rejected_total` | counter | `serving_spec_verify` events (drafted − accepted; rolled back, never emitted) |
 | `apex_serving_spec_accepted_tokens` | histogram | `serving_spec_verify` events (accepted draft length per verify; token-count buckets) |
 | `apex_serving_spec_speedup` | gauge | scheduler, per step once a verify has run (tokens emitted per verify dispatch; 1.0 == plain decode) |
+| `apex_serving_block_pool_utilization` | gauge | scheduler, every step while a paged engine serves (allocated KV pool blocks / allocatable blocks) |
+| `apex_serving_block_alias_hits_total` | counter | `serving_block_alias` events (prefix-cache blocks reused by table aliasing — zero-copy hits) |
+| `apex_serving_block_cow_total` | counter | `serving_block_cow` events (copy-on-write block copies — a write hit a shared block) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats

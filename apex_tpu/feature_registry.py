@@ -77,3 +77,6 @@ register("prefix_cache", "cross-request prefix caching: chain-hashed shared-prom
          False, "jnp/XLA + host block store")
 register("obs", "metrics registry + span tracing + Prometheus/Chrome-trace exporters",
          False, "host-side stdlib")
+register("serving_slo", "request-level lifecycle traces + deterministic open-loop "
+         "load generation + SLO percentile reports (TTFT/TPOT/queue-wait/goodput)",
+         False, "host-side stdlib")

@@ -17,6 +17,17 @@ that makes it measurable in-process instead of via log grep:
   replica desync, serving queued/first-token/finished, batch skipped)
   automatically increments a counter and stamps the active span — zero
   call-site churn.  Installed on import.
+- :mod:`apex_tpu.obs.request_trace` — a second event sink that folds
+  the serving event stream into **per-request lifecycle records**
+  (queued → admitted → prefill chunks → first token → decode →
+  finished, with exact phase durations and prefix/speculation
+  annotations), exported as one-track-per-request Perfetto traces and
+  JSONL.  Default-off: no recorder installed ⇒ nothing runs.
+- :mod:`apex_tpu.obs.slo` — SLO percentile reports over those records:
+  nearest-rank p50/p95/p99 TTFT / TPOT / queue-wait from exact
+  samples, goodput against per-request deadlines, cross-checked
+  against the live histograms' bucket-interpolated
+  :meth:`~apex_tpu.obs.metrics.Histogram.quantile` estimates.
 
 The resilience supervisor, checkpoint manager, serving scheduler/engine
 and pipeline timers all publish into the default registry; see
@@ -26,7 +37,7 @@ exporter attached the per-update overhead is a lock + dict write
 (``bench.py``'s ``obs`` block keeps it honest).
 """
 
-from apex_tpu.obs import bridge, metrics, trace
+from apex_tpu.obs import bridge, metrics, request_trace, slo, trace
 from apex_tpu.obs.metrics import (
     LATENCY_BUCKETS_S,
     Counter,
@@ -40,6 +51,18 @@ from apex_tpu.obs.metrics import (
     prometheus_text,
     snapshot,
     write_json,
+)
+from apex_tpu.obs.request_trace import (
+    RequestRecord,
+    RequestTraceRecorder,
+    recording_requests,
+)
+from apex_tpu.obs.slo import (
+    SLOReport,
+    build_report,
+    crosscheck_quantiles,
+    percentile,
+    summarize,
 )
 from apex_tpu.obs.trace import (
     Span,
@@ -61,22 +84,32 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "REGISTRY",
+    "RequestRecord",
+    "RequestTraceRecorder",
+    "SLOReport",
     "Span",
     "TraceRecorder",
     "bridge",
+    "build_report",
     "counter",
+    "crosscheck_quantiles",
     "current_span",
     "gauge",
     "histogram",
     "install_recorder",
     "metrics",
+    "percentile",
     "profile_on_stall",
     "prometheus_text",
     "recording",
+    "recording_requests",
+    "request_trace",
+    "slo",
     "snapshot",
     "span",
     "start_jax_profiler",
     "stop_jax_profiler",
+    "summarize",
     "trace",
     "uninstall_recorder",
     "write_json",

@@ -71,6 +71,14 @@ CHECKPOINTS_REJECTED = metrics.counter(
 SERVING_TTFT = metrics.histogram(
     "apex_serving_ttft_seconds",
     "request submit -> first token (queue wait + prefill)")
+SERVING_QUEUE_WAIT = metrics.histogram(
+    "apex_serving_queue_wait_seconds",
+    "request submit -> slot admission (time spent waiting for "
+    "capacity; the queueing component of TTFT)")
+SERVING_GOODPUT = metrics.gauge(
+    "apex_serving_goodput_ratio",
+    "requests meeting their deadline / requests offered, for the most "
+    "recent deadline-carrying open-loop loadgen run")
 SERVING_PREFILL_DURATION = metrics.histogram(
     "apex_serving_prefill_duration_seconds",
     "wall time of one prefill-chunk dispatch, by bucket size",
@@ -196,6 +204,12 @@ def _on_serving_first_token(event: dict) -> None:
         SERVING_TTFT.observe(ttft_s)
 
 
+def _on_serving_request_admitted(event: dict) -> None:
+    queue_wait_s = _measurement(event, "queue_wait_s")
+    if queue_wait_s is not None:
+        SERVING_QUEUE_WAIT.observe(queue_wait_s)
+
+
 def _on_serving_prefill_chunk(event: dict) -> None:
     duration_s = _measurement(event, "duration_s")
     bucket = event.get("bucket")
@@ -261,6 +275,7 @@ _HANDLERS = {
     "fault_injected": _on_fault_injected,
     "checkpoint_rejected": _on_checkpoint_rejected,
     "serving_first_token": _on_serving_first_token,
+    "serving_request_admitted": _on_serving_request_admitted,
     "serving_prefill_chunk": _on_serving_prefill_chunk,
     "serving_prefix_hit": _on_serving_prefix_hit,
     "serving_prefix_miss": _on_serving_prefix_miss,

@@ -325,6 +325,66 @@ class Histogram(_Metric):
             out.append(running)
         return tuple(out)
 
+    def quantile(self, q: float, **labels) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        ``histogram_quantile`` semantics, computed in-process).
+
+        The target rank ``q * count`` is located in the cumulative
+        bucket counts and linearly interpolated between the bucket's
+        edges (the first bucket's lower edge is taken as 0 when its
+        upper edge is positive; a rank landing in the implicit ``+Inf``
+        bucket clamps to the largest finite edge).  **Exact at bucket
+        edges**: when the rank coincides with a cumulative count the
+        estimate is exactly that bucket's upper edge — so a quantile
+        backed by samples observed *at* edges reproduces them exactly.
+
+        Error bound: the true sample quantile lies in the same bucket
+        as the estimate, i.e. within one bucket width ``(lo, hi]`` —
+        for the default log-spaced :data:`LATENCY_BUCKETS_S` (4/decade)
+        that is a ≤ 78% relative band (``10^(1/4) ≈ 1.78``).  Use
+        exact per-request samples (:mod:`apex_tpu.obs.slo`) when
+        tighter truth is needed; this estimate is the scrape-side
+        cross-check.
+
+        ``q`` must be a finite value in [0, 1] (the same guard family
+        as :meth:`observe`); an empty series returns NaN.
+        """
+        if not 0 <= q <= 1:              # False for NaN too
+            raise ValueError(
+                f"{self.name}: quantile must be in [0, 1], got {q}")
+        state = self._state(**labels)
+        count = state["count"]
+        if count == 0:
+            return float("nan")
+        counts = state["counts"]
+        edges = self.buckets
+
+        def lower_edge(i: int) -> float:
+            if i > 0:
+                return edges[i - 1]
+            return 0.0 if edges[0] > 0 else edges[0]
+
+        target = q * count
+        if target <= 0:
+            # q == 0: the lower edge of the first populated bucket
+            for i, c in enumerate(counts):
+                if c > 0:
+                    return (edges[-1] if i == len(edges)
+                            else float(lower_edge(i)))
+        running = 0
+        for i, c in enumerate(counts[:-1]):
+            running += c
+            if running >= target:
+                # smallest bucket whose cumulative count reaches the
+                # rank; c > 0 here by construction
+                lo, hi = lower_edge(i), edges[i]
+                frac = (target - (running - c)) / c
+                return float(lo + (hi - lo) * frac)
+        # rank lives in the +Inf bucket: clamp to the largest finite
+        # edge (the Prometheus convention — the estimate cannot invent
+        # an upper bound the buckets never recorded)
+        return float(edges[-1])
+
     def _collect(self):
         with self._lock:
             return sorted(

@@ -61,6 +61,18 @@ head matmul), its amp policies, and its resilience checkpoints:
   structured telemetry (queue depth, prefill backlog, per-chunk
   dispatch time, TTFT, per-token latency, tokens/s) via
   ``emit_event``.
+- :mod:`.loadgen` — deterministic **open-loop workload generation**:
+  seeded arrival processes (uniform / Poisson / burst trains), the
+  canonical prompt mixes (shared-prefix fleet, zero-overlap, the
+  bench's short-skewed length recipe), per-request deadlines, and a
+  :class:`LoadGenerator` that drives the scheduler at controlled
+  offered load on its injectable clock — sleep-free and bit-
+  reproducible on a :class:`VirtualClock`, shedding arrivals at
+  :class:`QueueFull` so overload shows up as goodput, not as a slowed
+  arrival process.  Pairs with
+  :class:`apex_tpu.obs.RequestTraceRecorder` +
+  :func:`apex_tpu.obs.build_report` for p50/p95/p99 TTFT / TPOT /
+  queue-wait and goodput SLO reports.
 - :mod:`.weights` — :func:`load_serving_params`: newest *valid* step
   from a resilience checkpoint root (v1 whole-tree and v2 sharded both
   work), params subtree selection, and bf16 serving casts through
@@ -83,6 +95,19 @@ End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
 """
 
 from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
+from apex_tpu.serving.loadgen import (
+    LoadGenerator,
+    LoadgenResult,
+    OpenLoopWorkload,
+    VirtualClock,
+    burst_arrivals,
+    make_workload,
+    mixed_length_prompts,
+    poisson_arrivals,
+    shared_prefix_prompts,
+    uniform_arrivals,
+    zero_overlap_prompts,
+)
 from apex_tpu.serving.engine import (
     DecodeEngine,
     default_draft_buckets,
@@ -148,5 +173,16 @@ __all__ = [
     "Request",
     "RequestPhase",
     "RequestResult",
+    "LoadGenerator",
+    "LoadgenResult",
+    "OpenLoopWorkload",
+    "VirtualClock",
+    "burst_arrivals",
+    "make_workload",
+    "mixed_length_prompts",
+    "poisson_arrivals",
+    "shared_prefix_prompts",
+    "uniform_arrivals",
+    "zero_overlap_prompts",
     "load_serving_params",
 ]

@@ -1,0 +1,431 @@
+"""Deterministic open-loop workload generation for the serving stack.
+
+The bench's closed-loop staggered streams answer "how fast can the
+engine drain N requests back-to-back" — but serving comparisons in the
+literature are stated at controlled *offered load*: drive the system at
+λ requests/s regardless of completions and read the latency/goodput
+distributions that queueing produces (the MLPerf-inference open-loop
+methodology; the Gemma-on-TPU serving comparison's
+throughput-vs-latency curves — PAPERS.md).  A closed-loop driver can
+never expose queueing: it only submits when the system is ready.
+
+This module is that workload driver, built deterministic end to end:
+
+- **Arrival processes** (:func:`uniform_arrivals`,
+  :func:`poisson_arrivals`, :func:`burst_arrivals`): offset tables in
+  seconds, generated from a seeded ``numpy`` Generator — the same seed
+  is the same schedule, bit for bit, forever.
+- **Prompt mixes** (:func:`shared_prefix_prompts`,
+  :func:`zero_overlap_prompts`, :func:`mixed_length_prompts`): the
+  workload classes the serving PRs optimize for — a fleet sharing one
+  system prompt (prefix caching's case), disjoint prompts (its
+  no-regression case), and the bench's short-skewed length recipe
+  (bucketed prefill's case) — all seeded.
+- **:class:`OpenLoopWorkload`**: requests + arrival offsets +
+  per-request completion deadlines, zipped and validated.
+- **:class:`LoadGenerator`**: drives a
+  :class:`~apex_tpu.serving.scheduler.ContinuousBatchingScheduler`
+  open-loop on the scheduler's own clock — requests are submitted the
+  moment their offset comes due, :class:`QueueFull` rejections are
+  *shed* (counted against goodput, never retried: open-loop means the
+  arrival process does not slow down for the system), and the loop
+  steps the scheduler until the workload drains.  On a
+  :class:`VirtualClock` with ``step_time_s`` set, the entire run is
+  sleep-free and deterministic: every latency in the result is an
+  exact multiple of ``step_time_s`` (the tier-1 timing tests).  On the
+  default monotonic clock the loop busy-steps an idle scheduler until
+  the next arrival (cheap host no-ops; the bench's case).
+
+Goodput (requests completing within their deadline / requests offered)
+is the honest overload metric — throughput alone rewards a system for
+finishing requests it already failed.  When any deadline is set, the
+run publishes ``apex_serving_goodput_ratio``; with no deadlines the
+metric stream is untouched (the house default-off identity rule).
+:mod:`apex_tpu.obs.slo` turns the per-request records of a run into
+percentile reports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.serving.scheduler import (
+    ContinuousBatchingScheduler,
+    QueueFull,
+    Request,
+    RequestResult,
+)
+
+__all__ = [
+    "LoadGenerator",
+    "LoadgenResult",
+    "OpenLoopWorkload",
+    "VirtualClock",
+    "burst_arrivals",
+    "make_workload",
+    "mixed_length_prompts",
+    "poisson_arrivals",
+    "shared_prefix_prompts",
+    "uniform_arrivals",
+    "zero_overlap_prompts",
+]
+
+logger = get_logger("serving.loadgen")
+
+
+class VirtualClock:
+    """A monotonic clock that moves only when told to.
+
+    Pass one instance as ``clock=`` to the scheduler, the
+    :class:`~apex_tpu.obs.request_trace.RequestTraceRecorder`, AND the
+    load generator's workload math (they all read the same object), and
+    every latency in a test becomes an exact arithmetic fact — no
+    sleeps, no flaky wall-clock margins.  Binary-friendly steps
+    (0.25, 0.125) keep the arithmetic float-exact.
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"cannot advance a monotonic clock by {dt}")
+        self._t += float(dt)
+        return self._t
+
+
+# ---------------------------------------------------------------------------
+# arrival processes — offset tables in seconds, deterministic by seed
+# ---------------------------------------------------------------------------
+
+def uniform_arrivals(n: int, rate_rps: float) -> Tuple[float, ...]:
+    """``n`` arrivals equally spaced at ``rate_rps`` requests/s,
+    starting at t=0 (offset ``i / rate``)."""
+    if n < 1 or rate_rps <= 0:
+        raise ValueError(f"need n >= 1 and rate_rps > 0, got "
+                         f"n={n} rate_rps={rate_rps}")
+    return tuple(i / rate_rps for i in range(n))
+
+
+def poisson_arrivals(n: int, rate_rps: float, seed: int = 0
+                     ) -> Tuple[float, ...]:
+    """``n`` arrivals of a seeded Poisson process at mean ``rate_rps``
+    (i.i.d. exponential gaps; same seed ⇒ same schedule, bit for bit)."""
+    if n < 1 or rate_rps <= 0:
+        raise ValueError(f"need n >= 1 and rate_rps > 0, got "
+                         f"n={n} rate_rps={rate_rps}")
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate_rps, size=n)
+    return tuple(np.cumsum(gaps) - gaps[0])       # first arrival at t=0
+
+
+def burst_arrivals(n: int, burst: int, period_s: float,
+                   spacing_s: float = 0.0) -> Tuple[float, ...]:
+    """Burst trains: groups of ``burst`` requests every ``period_s``
+    seconds, ``spacing_s`` apart inside a group (0 == simultaneous) —
+    the bursty workload the ROADMAP grades SLO scheduling by.  Mean
+    offered load is ``burst / period_s``."""
+    if n < 1 or burst < 1 or period_s <= 0 or spacing_s < 0:
+        raise ValueError(
+            f"need n >= 1, burst >= 1, period_s > 0, spacing_s >= 0; "
+            f"got n={n} burst={burst} period_s={period_s} "
+            f"spacing_s={spacing_s}")
+    if spacing_s * (burst - 1) >= period_s:
+        raise ValueError(
+            f"a burst of {burst} at spacing {spacing_s}s outlasts its "
+            f"own period {period_s}s — not a burst train")
+    return tuple((i // burst) * period_s + (i % burst) * spacing_s
+                 for i in range(n))
+
+
+# ---------------------------------------------------------------------------
+# prompt mixes — seeded token-id lists
+# ---------------------------------------------------------------------------
+
+def _token_list(rng, n: int, vocab: int) -> List[int]:
+    return [int(x) for x in rng.integers(0, vocab, n)]
+
+
+def shared_prefix_prompts(n: int, *, shared_len: int, suffix_len: int,
+                          vocab: int, seed: int = 0) -> List[List[int]]:
+    """A chatbot fleet: one shared system prompt of ``shared_len``
+    tokens + a unique ``suffix_len``-token tail per request (the
+    prefix-cache hit workload)."""
+    rng = np.random.default_rng(seed)
+    shared = _token_list(rng, shared_len, vocab)
+    return [shared + _token_list(rng, suffix_len, vocab)
+            for _ in range(n)]
+
+
+def zero_overlap_prompts(n: int, *, length: int, vocab: int,
+                         seed: int = 0) -> List[List[int]]:
+    """Disjoint random prompts (the prefix cache's no-regression
+    workload; every admission is a miss)."""
+    rng = np.random.default_rng(seed)
+    return [_token_list(rng, length, vocab) for _ in range(n)]
+
+
+#: the bench's mixed-length skew (short-heavy real traffic): fractions
+#: of ``prefill_len`` cycled per request — one recipe, shared with
+#: ``bench.py``'s ``serving`` mixed block.
+LENGTH_SKEW_FRACTIONS = (1 / 8, 1 / 8, 1 / 8, 1 / 8, 3 / 16, 1 / 4,
+                         1 / 2, 1)
+
+
+def mixed_length_prompts(n: int, *, prefill_len: int, vocab: int,
+                         seed: int = 0, max_len: Optional[int] = None
+                         ) -> List[List[int]]:
+    """Mixed prompt lengths over the bench's short-skewed recipe
+    (:data:`LENGTH_SKEW_FRACTIONS` of ``prefill_len``, cycled), token
+    ids seeded; lengths clamped under ``max_len`` when given."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        length = max(1, int(prefill_len
+                            * LENGTH_SKEW_FRACTIONS[
+                                i % len(LENGTH_SKEW_FRACTIONS)]))
+        if max_len is not None:
+            length = min(length, max_len)
+        out.append(_token_list(rng, length, vocab))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the workload + the driver
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class OpenLoopWorkload:
+    """Requests + arrival offsets (+ optional per-request completion
+    deadlines, relative to arrival) in arrival order."""
+
+    requests: Tuple[Request, ...]
+    arrivals: Tuple[float, ...]            # offsets from run start, sorted
+    deadlines: Tuple[Optional[float], ...]  # relative to arrival; None=∞
+
+    def __post_init__(self):
+        n = len(self.requests)
+        if n < 1:
+            raise ValueError("empty workload")
+        if len(self.arrivals) != n or len(self.deadlines) != n:
+            raise ValueError(
+                f"requests/arrivals/deadlines length mismatch: "
+                f"{n}/{len(self.arrivals)}/{len(self.deadlines)}")
+        if any(b < a for a, b in zip(self.arrivals, self.arrivals[1:])):
+            raise ValueError("arrival offsets must be non-decreasing")
+        if self.arrivals[0] < 0:
+            raise ValueError(
+                f"first arrival offset {self.arrivals[0]} < 0")
+        if any(d is not None and d <= 0 for d in self.deadlines):
+            raise ValueError("deadlines must be positive (or None)")
+        rids = [r.rid for r in self.requests]
+        if len(set(rids)) != len(rids):
+            raise ValueError("duplicate rids in workload")
+
+    @property
+    def offered(self) -> int:
+        return len(self.requests)
+
+    @property
+    def offered_rps(self) -> float:
+        """Mean offered load over the arrival window (n-1 gaps)."""
+        span = self.arrivals[-1] - self.arrivals[0]
+        if len(self.arrivals) < 2 or span <= 0:
+            return float("inf")
+        return (len(self.arrivals) - 1) / span
+
+    def schedule_fingerprint(self) -> str:
+        """Hex digest over arrival offsets + every prompt's token ids +
+        per-request generation config — two workloads with equal
+        fingerprints produce identical token streams on a deterministic
+        scheduler (the bit-reproducibility witness the bench asserts)."""
+        import hashlib
+
+        h = hashlib.blake2b(digest_size=16)
+        for req, off, dl in zip(self.requests, self.arrivals,
+                                self.deadlines):
+            h.update(repr((req.rid, tuple(req.prompt),
+                           req.max_new_tokens, req.eos_id,
+                           req.temperature, req.top_k, req.seed,
+                           float(off),
+                           None if dl is None else float(dl))).encode())
+        return h.hexdigest()
+
+
+def make_workload(prompts: Sequence[Sequence[int]],
+                  arrivals: Sequence[float], *,
+                  max_new_tokens: int,
+                  deadline_s: Optional[float] = None,
+                  eos_id: Optional[int] = None,
+                  temperature: float = 0.0, top_k: int = 0,
+                  seed: int = 0,
+                  rid_prefix: str = "lg") -> OpenLoopWorkload:
+    """Zip a prompt mix with an arrival table into an
+    :class:`OpenLoopWorkload` (one shared ``deadline_s`` / generation
+    config; build the dataclass directly for per-request variety)."""
+    if len(prompts) != len(arrivals):
+        raise ValueError(f"{len(prompts)} prompts vs {len(arrivals)} "
+                         f"arrivals")
+    requests = tuple(
+        Request(f"{rid_prefix}{i}", list(p), max_new_tokens=max_new_tokens,
+                eos_id=eos_id, temperature=temperature, top_k=top_k,
+                seed=seed + i)
+        for i, p in enumerate(prompts))
+    return OpenLoopWorkload(requests=requests,
+                            arrivals=tuple(float(a) for a in arrivals),
+                            deadlines=(deadline_s,) * len(requests))
+
+
+@dataclasses.dataclass
+class LoadgenResult:
+    """One open-loop run's outcome: completions, shed arrivals, and the
+    deadline bookkeeping an :class:`~apex_tpu.obs.slo.SLOReport`
+    consumes.  ``arrivals`` are *absolute* clock stamps — deadlines are
+    enforced from arrival, not from (possibly later) submission, so a
+    step boundary's submit lag can never quietly extend a deadline."""
+
+    offered: int
+    completed: int
+    rejected: List[str]                    # shed at QueueFull, in order
+    results: Dict[str, RequestResult]      # rid -> scheduler result
+    deadlines: Dict[str, Optional[float]]  # rid -> deadline from arrival
+    arrivals: Dict[str, float]             # rid -> absolute arrival stamp
+    met_deadline: Dict[str, bool]          # rid -> completed within it
+    duration_s: float
+    steps: int
+
+    @property
+    def goodput(self) -> Optional[float]:
+        """Requests meeting their deadline / offered (None when the
+        workload carries no deadlines — goodput is then undefined, not
+        1.0)."""
+        if all(d is None for d in self.deadlines.values()):
+            return None
+        return sum(self.met_deadline.values()) / max(self.offered, 1)
+
+
+class LoadGenerator:
+    """Drive a scheduler open-loop through one workload.
+
+    >>> gen = LoadGenerator(sched, workload)         # real clock
+    >>> out = gen.run()
+    >>> gen = LoadGenerator(sched, workload, step_time_s=0.25)  # virtual
+    >>> out = gen.run()                              # fully deterministic
+
+    ``step_time_s`` is the virtual cost of one scheduler step: after
+    each ``sched.step()`` the scheduler's clock (which must then be a
+    :class:`VirtualClock`) advances by it.  Leave it ``None`` on the
+    real monotonic clock (the bench).  The loop submits every arrival
+    whose offset has come due *before* each step — open-loop: arrivals
+    never wait for capacity, and a full queue sheds the request
+    (recorded in ``rejected``, charged against goodput).
+    """
+
+    def __init__(self, scheduler: ContinuousBatchingScheduler,
+                 workload: OpenLoopWorkload, *,
+                 step_time_s: Optional[float] = None,
+                 max_steps: Optional[int] = None):
+        clock = scheduler.clock
+        if step_time_s is not None:
+            if step_time_s <= 0:
+                raise ValueError(
+                    f"step_time_s must be > 0, got {step_time_s}")
+            if not hasattr(clock, "advance"):
+                raise ValueError(
+                    "step_time_s needs an advanceable scheduler clock "
+                    "— construct the scheduler with "
+                    "clock=VirtualClock()")
+        self.scheduler = scheduler
+        self.workload = workload
+        self.step_time_s = step_time_s
+        self.max_steps = max_steps
+        self._clock: Callable[[], float] = clock
+
+    def run(self) -> LoadgenResult:
+        sched, wl = self.scheduler, self.workload
+        t_start = self._clock()
+        i = 0
+        n = wl.offered
+        rejected: List[str] = []
+        submit_stamps: Dict[str, float] = {}
+        steps = 0
+        emit_event("loadgen_started", offered=n,
+                   fingerprint=wl.schedule_fingerprint(),
+                   offered_rps=(None if wl.offered_rps == float("inf")
+                                else round(wl.offered_rps, 6)))
+        while i < n or sched.queue_depth or sched.active_count:
+            now = self._clock() - t_start
+            while i < n and wl.arrivals[i] <= now + 1e-12:
+                req = wl.requests[i]
+                try:
+                    sched.submit(req)
+                    submit_stamps[req.rid] = self._clock()
+                except QueueFull:
+                    # open-loop: the arrival process never slows down
+                    # for the system — a full queue sheds the request
+                    rejected.append(req.rid)
+                    emit_event("loadgen_request_shed", rid=req.rid,
+                               queue_depth=sched.queue_depth)
+                i += 1
+            if i >= n and not (sched.queue_depth or sched.active_count):
+                break                       # everything shed or done
+            t_before = self._clock()
+            sched.step()
+            steps += 1
+            if self.step_time_s is not None:
+                self._clock.advance(self.step_time_s)
+            elif (self._clock() == t_before and i < n
+                  and not (sched.queue_depth or sched.active_count)):
+                raise RuntimeError(
+                    "scheduler clock did not advance across an idle "
+                    "step with arrivals still pending — a virtual "
+                    "clock needs step_time_s= (the run would spin "
+                    "forever)")
+            if self.max_steps is not None and steps >= self.max_steps:
+                break
+        duration_s = self._clock() - t_start
+        all_results = sched.results          # ONE copy of the property
+        results = {r.rid: all_results[r.rid] for r in wl.requests
+                   if r.rid in all_results}
+        deadlines = {r.rid: d for r, d in zip(wl.requests, wl.deadlines)}
+        arrivals = {r.rid: t_start + off
+                    for r, off in zip(wl.requests, wl.arrivals)}
+        met = {}
+        for req, deadline in zip(wl.requests, wl.deadlines):
+            res = results.get(req.rid)
+            if res is None:
+                met[req.rid] = False
+                continue
+            # enforced from ARRIVAL, not submission: submits happen at
+            # step boundaries, so a request due mid-step is submitted
+            # late — that lag must tighten its remaining budget, never
+            # extend the deadline
+            finish_abs = submit_stamps[req.rid] + res.total_s
+            met[req.rid] = bool(
+                deadline is None
+                or finish_abs - arrivals[req.rid] <= deadline)
+        out = LoadgenResult(offered=n, completed=len(results),
+                            rejected=rejected, results=results,
+                            deadlines=deadlines, arrivals=arrivals,
+                            met_deadline=met,
+                            duration_s=duration_s, steps=steps)
+        goodput = out.goodput
+        if goodput is not None:
+            # only a deadline-carrying workload touches the metric —
+            # the default stream stays byte-identical (house rule)
+            from apex_tpu.obs import bridge as obs_bridge
+
+            obs_bridge.SERVING_GOODPUT.set(goodput)
+        emit_event("loadgen_finished", offered=n,
+                   completed=out.completed, shed=len(rejected),
+                   steps=steps, duration_s=round(duration_s, 6),
+                   goodput=(None if goodput is None
+                            else round(goodput, 6)))
+        return out

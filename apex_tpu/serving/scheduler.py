@@ -324,6 +324,17 @@ class ContinuousBatchingScheduler:
 
     # ---- introspection ---------------------------------------------------
     @property
+    def clock(self) -> Callable[[], float]:
+        """The injectable monotonic clock every timing field
+        (``t_submit`` / ``ttft_s`` / ``per_token_ms`` / event
+        ``duration_s``) is measured on — ``time.monotonic`` by default.
+        The load generator and request-trace recorder read THIS so all
+        three layers stamp one timeline (a
+        :class:`~apex_tpu.serving.loadgen.VirtualClock` here makes
+        every latency in a test deterministic)."""
+        return self._clock
+
+    @property
     def queue_depth(self) -> int:
         return len(self._queue)
 
@@ -420,9 +431,14 @@ class ContinuousBatchingScheduler:
             self._active[slot] = st
             logger.debug("admitted %s into slot %d (queue %d deep)",
                          request.rid, slot, len(self._queue))
+            # queue_wait_s rides the event so the obs bridge can feed
+            # the apex_serving_queue_wait_seconds histogram and the
+            # request-trace recorder can cross-check its own stamps —
+            # measured on this scheduler's (injectable) clock
             emit_event("serving_request_admitted", rid=request.rid,
                        slot=slot, prompt_tokens=len(request.prompt),
-                       queue_depth=len(self._queue))
+                       queue_depth=len(self._queue),
+                       queue_wait_s=round(self._clock() - t_submit, 6))
             if self._prefix is not None:
                 self._match_and_restore(st)
 

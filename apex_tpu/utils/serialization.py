@@ -36,10 +36,32 @@ def atomic_write_json(path: str, payload: Any, **dump_kwargs) -> None:
     helper for every small-JSON writer in the tree — heartbeats, metric
     snapshots, trace exports.
     """
+    _atomic_write_text(path, lambda f: json.dump(payload, f,
+                                                 **dump_kwargs))
+
+
+def atomic_write_jsonl(path: str, rows: Any, **dump_kwargs) -> None:
+    """Crash-safe JSON-Lines rewrite: one compact ``json.dumps`` line
+    per row, through the same temp + ``fsync`` + ``os.replace`` dance
+    as :func:`atomic_write_json` — a reader never sees a half-written
+    file.  Rows must each be JSON-serializable under ``dump_kwargs``
+    (pre-sanitize with :func:`json_finite` for ``allow_nan=False``)."""
+    def write(f):
+        for row in rows:
+            f.write(json.dumps(row, **dump_kwargs))
+            f.write("\n")
+
+    _atomic_write_text(path, write)
+
+
+def _atomic_write_text(path: str, write_fn) -> None:
+    """The one temp + ``fsync`` + ``os.replace`` implementation both
+    JSON writers share — a future fix to the atomic dance (parent-dir
+    fsync, collision handling) lands in exactly one place."""
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     try:
         with open(tmp, "w") as f:
-            json.dump(payload, f, **dump_kwargs)
+            write_fn(f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)

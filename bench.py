@@ -616,8 +616,10 @@ def _serving_metrics(*, decode_tokens: int = 48, prompt_len: int = 5,
     # traffic); outputs are short so admission cost dominates — the
     # workload the bucket table exists for.  Wall-clock on a shared CI
     # host flakes, so best-of-N attempts (the existing serving-test
-    # pattern), each attempt timing both configs back to back.
-    frac = (1 / 8, 1 / 8, 1 / 8, 1 / 8, 3 / 16, 1 / 4, 1 / 2, 1)
+    # pattern), each attempt timing both configs back to back.  The
+    # skew recipe is SHARED with loadgen.mixed_length_prompts — one
+    # definition, so the loadgen workload reproduces this block's mix
+    from apex_tpu.serving.loadgen import LENGTH_SKEW_FRACTIONS as frac
     mixed_lens = [max(1, min(int(prefill_len * frac[i % len(frac)]),
                              max_len - mixed_decode_tokens))
                   for i in range(mixed_streams)]
@@ -1174,6 +1176,138 @@ def _serving_paged_metrics(*, streams: int = 8, shared_len: int = 96,
     }
 
 
+def _serving_slo_metrics(*, n_requests: int = 24, prompt_len: int = 48,
+                         new_tokens: int = 12, prefill_len: int = 64,
+                         max_len: int = 128, slots: int = 4,
+                         burst: int = 4, seed: int = 7) -> dict:
+    """Request-level SLO percentiles under a bursty OPEN-LOOP workload
+    (the BENCH_*.json ``serving_slo`` block): the measurement layer the
+    ROADMAP's SLO-aware-scheduling work will be graded by.
+
+    Protocol: (1) a closed-loop drain of the same request mix measures
+    the sustainable completion rate; (2) a seeded burst-train workload
+    (``burst_arrivals``) drives the scheduler open-loop at ~1x and ~2x
+    that rate, a :class:`RequestTraceRecorder` assembling per-request
+    lifecycle records off the event stream; (3) each run renders an
+    :class:`SLOReport` — nearest-rank p50/p95/p99 TTFT / TPOT /
+    queue-wait over the exact samples, goodput against a deadline set
+    at 3x the closed-loop per-wave service time, cross-checked against
+    the bucket-interpolated Prometheus histogram quantiles.  The
+    arrival schedule + token streams are bit-reproducible by seed
+    (``schedule_fingerprint`` is recorded; the harness test pins it
+    stable across two builds), and the compile-count guards hold: the
+    recorder and load generator are pure host layers, so
+    ``decode_compiles == 1`` and prefill stays bounded by the bucket
+    table."""
+    from apex_tpu.obs import metrics as om
+    from apex_tpu.obs import request_trace as rt
+    from apex_tpu.obs import slo as oslo
+    from apex_tpu.obs.bridge import SERVING_QUEUE_WAIT, SERVING_TTFT
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  LoadGenerator, Request, burst_arrivals,
+                                  default_prefill_buckets, make_workload,
+                                  zero_overlap_prompts)
+
+    cfg, model, params = _serving_bench_setup(max_len=max_len)
+    # warm EVERY prefill bucket: the per-step budget fragments prompts
+    # into sub-bucket chunks (48 + 16, 32 + ...), so the closed-loop
+    # calibration run would otherwise pay those compiles inside its
+    # timed window and understate the sustainable rate ~2x — making
+    # "2x sustainable" quietly not an overload at all
+    eng, _warm_sched = _warm_serving_pair(
+        model, params, slots=slots, max_len=max_len,
+        prefill_len=prefill_len,
+        warm_lens=[prompt_len] + [b for b in
+                                  default_prefill_buckets(prefill_len)],
+        warm_prompt_len=min(prompt_len, max_len - 2))
+    prompts = zero_overlap_prompts(n_requests, length=prompt_len,
+                                   vocab=cfg.vocab_size, seed=seed)
+
+    # 1) sustainable rate: closed-loop drain (everything submitted up
+    # front) — the ceiling the open-loop factors are stated against
+    sched = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                        log_interval=10 ** 9)
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        sched.submit(Request(f"cl{i}", p, max_new_tokens=new_tokens))
+    sched.run()
+    closed_s = time.perf_counter() - t0
+    sustainable_rps = n_requests / max(closed_s, 1e-9)
+    # per-wave service time (slots requests drain together); the
+    # deadline every open-loop request carries is 3 waves — generous at
+    # 1x, increasingly missed as the 2x backlog builds
+    wave_s = closed_s / max(n_requests / slots, 1)
+    deadline_s = 3.0 * wave_s
+
+    loads = {}
+    for factor in (1.0, 2.0):
+        rate = sustainable_rps * factor
+        period_s = burst / max(rate, 1e-9)
+        workload = make_workload(
+            prompts, burst_arrivals(n_requests, burst=burst,
+                                    period_s=period_s),
+            max_new_tokens=new_tokens, deadline_s=deadline_s,
+            rid_prefix=f"slo{factor:g}_", seed=seed)
+        # reproducibility witness: the same seed builds the same
+        # schedule, bit for bit (prompts + offsets + config digested)
+        workload_again = make_workload(
+            prompts, burst_arrivals(n_requests, burst=burst,
+                                    period_s=period_s),
+            max_new_tokens=new_tokens, deadline_s=deadline_s,
+            rid_prefix=f"slo{factor:g}_", seed=seed)
+        fingerprint = workload.schedule_fingerprint()
+        assert fingerprint == workload_again.schedule_fingerprint(), \
+            "same-seed workload rebuild changed the schedule"
+        # a clean registry makes the histogram cross-check exact: the
+        # TTFT/queue-wait series then hold exactly this run's samples
+        om.reset()
+        sched = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                            log_interval=10 ** 9)
+        rec = rt.RequestTraceRecorder().install()
+        try:
+            out = LoadGenerator(sched, workload).run()
+        finally:
+            rec.uninstall()
+        report = oslo.build_report(
+            rec.records(), offered=out.offered, deadlines=out.deadlines,
+            arrivals=out.arrivals, duration_s=out.duration_s,
+            histograms={"ttft": SERVING_TTFT,
+                        "queue_wait": SERVING_QUEUE_WAIT})
+        d = report.to_dict()
+        loads[f"{factor:g}x"] = {
+            "offered_rps": round(rate, 2),
+            "burst": burst, "period_s": round(period_s, 4),
+            "fingerprint": fingerprint,
+            "completed": d["completed"], "shed": len(out.rejected),
+            "steps": out.steps,
+            "duration_s": d["duration_s"],
+            "ttft_s": {k: d["ttft_s"][k]
+                       for k in ("p50", "p95", "p99", "mean", "n")},
+            "tpot_s": {k: d["tpot_s"][k]
+                       for k in ("p50", "p95", "p99", "mean", "n")},
+            "queue_wait_s": {k: d["queue_wait_s"][k]
+                             for k in ("p50", "p95", "p99", "mean",
+                                       "n")},
+            "goodput": d["goodput"],
+            "deadline_misses": d["deadline_misses"],
+            "crosscheck_aligned": all(
+                c["aligned"] for c in d["crosscheck"].values()),
+        }
+    return {
+        "ok": True,
+        "sustainable_rps": round(sustainable_rps, 2),
+        "deadline_s": round(deadline_s, 4),
+        "loads": loads,
+        "decode_compiles": eng.decode_compiles(),
+        "prefill_compiles": eng.prefill_compiles(),
+        "prefill_buckets": list(eng.prefill_buckets),
+        "config": {"n_requests": n_requests, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "slots": slots,
+                   "max_len": max_len, "prefill_len": prefill_len,
+                   "seed": seed},
+    }
+
+
 def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
     """Observability tax of the ISSUE-6 layer (the BENCH_*.json ``obs``
     block): per-update cost of each instrument kind, span enter/exit
@@ -1420,6 +1554,11 @@ def run_config(name: str, *, batch: int | None = None,
         serving_paged = {"ok": False,
                          "error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        serving_slo = _serving_slo_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        serving_slo = {"ok": False,
+                       "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         obs = _obs_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
@@ -1441,6 +1580,7 @@ def run_config(name: str, *, batch: int | None = None,
         "serving_spec": serving_spec,
         "serving_prefix": serving_prefix,
         "serving_paged": serving_paged,
+        "serving_slo": serving_slo,
         "obs": obs,
         "config": out_cfg,
     }

@@ -1,0 +1,184 @@
+"""``tools/bench_compare.py`` — bench-round regression diffing
+(ISSUE 12 satellite): golden fixtures for every classification family,
+tolerance semantics, and the nonzero exit code on regression."""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+import bench_compare as bc  # noqa: E402
+
+# a miniature bench result exercising every classification family
+GOLDEN_OLD = {
+    "metric": "llama_tokens_per_sec_per_chip",
+    "value": 1000.0,
+    "step_time_ms": 50.0,
+    "serving": {
+        "ok": True,
+        "decode_ms_per_token": 4.0,
+        "throughput_tokens_per_s": {"4": 200.0},
+        "speedup_4_vs_sequential": 3.0,
+        "decode_compiles_after_warmup": 1,
+        "config": {"slots": 8},
+    },
+    "serving_slo": {
+        "ok": True,
+        "loads": {"2x": {"ttft_s": {"p99": 0.10, "n": 24},
+                         "goodput": 0.8}},
+    },
+}
+
+
+def _mutated(**paths):
+    """Deep-copy the golden with dotted-path overrides."""
+    new = json.loads(json.dumps(GOLDEN_OLD))
+    for dotted, value in paths.items():
+        parts = dotted.split(".")
+        node = new
+        for p in parts[:-1]:
+            node = node[p]
+        node[parts[-1]] = value
+    return new
+
+
+def _kinds(findings):
+    return {f.path: f.kind for f in findings}
+
+
+class TestClassify:
+    def test_families(self):
+        assert bc.classify("serving.throughput_tokens_per_s.4") == "higher"
+        assert bc.classify("value") == "higher"
+        assert bc.classify("serving.speedup_4_vs_sequential") == "higher"
+        assert bc.classify("serving_slo.loads.2x.goodput") == "higher"
+        assert bc.classify("mfu") == "higher"
+        assert bc.classify("step_time_ms") == "lower"
+        assert bc.classify("serving.decode_ms_per_token") == "lower"
+        assert bc.classify("serving_slo.loads.2x.ttft_s.p99") == "lower"
+        assert bc.classify("slo.queue_wait_s.p95") == "lower"
+        assert bc.classify("serving.decode_compiles_after_warmup") == "exact"
+        assert bc.classify("serving.ok") == "exact_higher"
+
+    def test_informational(self):
+        assert bc.classify("serving.config.slots") is None
+        assert bc.classify("config.params_m") is None
+        assert bc.classify("serving_slo.loads.2x.ttft_s.n") is None
+        assert bc.classify("attempts") is None
+        assert bc.classify("prefill_buckets[0]") is None
+
+
+class TestFlatten:
+    def test_nested_paths_and_lists(self):
+        leaves = dict((leaf.path, leaf.value)
+                      for leaf in bc.flatten({"a": {"b": [1, 2]},
+                                              "ok": True, "s": "x"}))
+        assert leaves == {"a.b[0]": 1.0, "a.b[1]": 2.0, "ok": 1.0}
+
+
+class TestCompare:
+    def test_identical_is_clean(self):
+        findings = bc.compare(GOLDEN_OLD, GOLDEN_OLD)
+        assert not findings
+
+    def test_latency_regression_flagged(self):
+        new = _mutated(**{"serving.decode_ms_per_token": 5.0})  # +25%
+        kinds = _kinds(bc.compare(GOLDEN_OLD, new))
+        assert kinds["serving.decode_ms_per_token"] == "regression"
+
+    def test_within_tolerance_passes(self):
+        new = _mutated(**{"serving.decode_ms_per_token": 4.3})  # +7.5%
+        assert not bc.compare(GOLDEN_OLD, new)
+
+    def test_throughput_drop_flagged_and_direction_aware(self):
+        new = _mutated(value=800.0)                             # -20%
+        kinds = _kinds(bc.compare(GOLDEN_OLD, new))
+        assert kinds["value"] == "regression"
+        up = _mutated(**{"serving.decode_ms_per_token": 3.0})   # faster
+        kinds = _kinds(bc.compare(GOLDEN_OLD, up))
+        assert kinds["serving.decode_ms_per_token"] == "improvement"
+
+    def test_p99_and_goodput_graded(self):
+        worse = json.loads(json.dumps(GOLDEN_OLD))
+        worse["serving_slo"]["loads"]["2x"]["ttft_s"]["p99"] = 0.2
+        worse["serving_slo"]["loads"]["2x"]["goodput"] = 0.5
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        assert kinds["serving_slo.loads.2x.ttft_s.p99"] == "regression"
+        assert kinds["serving_slo.loads.2x.goodput"] == "regression"
+
+    def test_compile_count_zero_tolerance(self):
+        new = _mutated(**{"serving.decode_compiles_after_warmup": 2})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, new))
+        assert kinds["serving.decode_compiles_after_warmup"] == "regression"
+        fewer = _mutated(**{"serving.decode_compiles_after_warmup": 0})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, fewer))
+        assert kinds["serving.decode_compiles_after_warmup"] == "improvement"
+
+    def test_ok_flip_is_regression(self):
+        new = _mutated(**{"serving.ok": False})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, new))
+        assert kinds["serving.ok"] == "regression"
+
+    def test_missing_graded_metric_flagged(self):
+        new = json.loads(json.dumps(GOLDEN_OLD))
+        del new["serving"]["decode_ms_per_token"]
+        kinds = _kinds(bc.compare(GOLDEN_OLD, new))
+        assert kinds["serving.decode_ms_per_token"] == "missing"
+
+    def test_config_change_is_informational(self):
+        new = _mutated(**{"serving.config.slots": 16})
+        findings = bc.compare(GOLDEN_OLD, new)
+        assert _kinds(findings)["serving.config.slots"] == "info"
+        assert all(f.kind == "info" for f in findings)
+
+    def test_tolerance_override(self):
+        new = _mutated(**{"serving.decode_ms_per_token": 4.3})  # +7.5%
+        findings = bc.compare(GOLDEN_OLD, new,
+                              tol_overrides={r"decode_ms": 0.05})
+        assert _kinds(findings)["serving.decode_ms_per_token"] == \
+            "regression"
+
+    def test_regressions_sort_first(self):
+        new = _mutated(**{"serving.decode_ms_per_token": 10.0,
+                          "step_time_ms": 30.0})
+        findings = bc.compare(GOLDEN_OLD, new)
+        assert findings[0].kind == "regression"
+        assert findings[-1].kind == "improvement"
+
+
+class TestMain:
+    def _write(self, tmp_path, old, new):
+        po, pn = tmp_path / "BENCH_r1.json", tmp_path / "BENCH_r2.json"
+        po.write_text(json.dumps(old))
+        pn.write_text(json.dumps(new))
+        return str(po), str(pn)
+
+    def test_exit_zero_when_clean(self, tmp_path, capsys):
+        po, pn = self._write(tmp_path, GOLDEN_OLD, GOLDEN_OLD)
+        assert bc.main([po, pn]) == 0
+        assert "0 regression(s)" in capsys.readouterr().out
+
+    def test_exit_nonzero_on_regression(self, tmp_path, capsys):
+        po, pn = self._write(tmp_path, GOLDEN_OLD,
+                             _mutated(value=500.0))
+        assert bc.main([po, pn]) == 1
+        out = capsys.readouterr().out
+        assert "regression" in out and "value" in out
+
+    def test_tol_flag(self, tmp_path):
+        po, pn = self._write(tmp_path, GOLDEN_OLD,
+                             _mutated(**{"step_time_ms": 54.0}))  # +8%
+        assert bc.main([po, pn]) == 0
+        assert bc.main([po, pn, "--tol", "0.05"]) == 1
+
+    def test_newest_bench_files_by_round(self, tmp_path):
+        for r in (2, 10, 1):
+            (tmp_path / f"BENCH_r{r}.json").write_text("{}")
+        old, new = bc.newest_bench_files(str(tmp_path))
+        assert old.endswith("BENCH_r2.json")
+        assert new.endswith("BENCH_r10.json")
+        with pytest.raises(FileNotFoundError):
+            bc.newest_bench_files(str(tmp_path / "empty"))

@@ -27,14 +27,21 @@ MUST_MENTION = {
                "vit_l16", "llama2_7b"],
     "contrib": ["SoftmaxCrossEntropyLoss", "FocalLoss", "Transducer"],
     "serving": ["DecodeEngine", "ContinuousBatchingScheduler",
-                "load_serving_params", "cache_utilization"],
+                "load_serving_params", "cache_utilization",
+                "LoadGenerator", "burst_arrivals", "OpenLoopWorkload",
+                "schedule_fingerprint"],
     # the prologue (naming conventions + metric inventory + span
     # semantics) plus the introspected API must both be present
     "observability": ["MetricsRegistry", "Histogram", "prometheus_text",
                       "TraceRecorder", "recording", "profile_on_stall",
                       "apex_step_duration_seconds", "apex_serving_ttft_seconds",
                       "add_event_sink", "LATENCY_BUCKETS_S", "le=",
-                      "traceEvents"],
+                      "traceEvents",
+                      # ISSUE-12: request traces + SLO reports
+                      "RequestTraceRecorder", "build_report",
+                      "crosscheck_quantiles", "export_jsonl",
+                      "apex_serving_queue_wait_seconds",
+                      "apex_serving_goodput_ratio"],
     # the prologue (checkpoint format / recovery semantics / supervisor
     # sections) plus the introspected API must both be present
     "resilience": ["CheckpointManager", "FaultInjector", "make_guarded_step",

@@ -12,6 +12,7 @@ loadable Chrome trace, ending with the no-exporter overhead budget.
 
 import json
 import logging
+import math
 import threading
 import time
 
@@ -215,6 +216,84 @@ class TestHistogram:
         assert h.count(op="save") == 2
         assert h.count(op="restore") == 1
         assert h.cumulative_counts(op="save") == (1, 2)
+
+
+class TestHistogramQuantile:
+    """ISSUE-12 satellite: bucket-interpolated ``Histogram.quantile``
+    (exact at bucket edges, documented one-bucket error bound, the same
+    NaN/Inf guard family as ``observe``)."""
+
+    def test_exact_at_bucket_edges(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0, 2.0, 4.0))
+        for v in (1.0, 1.0, 2.0, 2.0):
+            h.observe(v)
+        # rank coincides with a cumulative count -> exactly the edge
+        assert h.quantile(0.5) == 1.0
+        assert h.quantile(1.0) == 2.0
+
+    def test_interpolates_within_bucket(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0, 2.0))
+        for _ in range(4):
+            h.observe(1.5)                # all in (1.0, 2.0]
+        # rank q*4=2 of 4 -> halfway through the bucket's count
+        assert h.quantile(0.5) == pytest.approx(1.5)
+        assert h.quantile(0.25) == pytest.approx(1.25)
+        # error bound: any estimate stays inside the populated bucket
+        for q in (0.01, 0.5, 0.99):
+            assert 1.0 <= h.quantile(q) <= 2.0
+
+    def test_first_bucket_lower_edge_is_zero(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0, 2.0))
+        h.observe(0.5)
+        h.observe(0.5)
+        assert h.quantile(0.5) == pytest.approx(0.5)   # 0 + 1.0 * 1/2
+        assert h.quantile(0.0) == 0.0
+
+    def test_overflow_bucket_clamps_to_last_edge(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0, 2.0))
+        h.observe(100.0)
+        assert h.quantile(0.99) == 2.0
+        assert h.quantile(0.0) == 2.0     # only populated "bucket"
+
+    def test_single_bucket_histogram(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0,))
+        h.observe(0.25)
+        h.observe(0.75)
+        assert h.quantile(0.5) == pytest.approx(0.5)
+        assert h.quantile(1.0) == 1.0
+        assert h.quantile(0.0) == 0.0
+
+    def test_empty_histogram_is_nan(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0,))
+        assert math.isnan(h.quantile(0.5))
+
+    def test_q_guards_match_observe_family(self, reg):
+        h = reg.histogram("apex_t_q_seconds", buckets=(1.0,))
+        h.observe(0.5)
+        for bad in (-0.01, 1.01, float("nan"), float("inf"),
+                    -float("inf")):
+            with pytest.raises(ValueError, match="quantile"):
+                h.quantile(bad)
+
+    def test_labeled_series_quantiles_independent(self, reg):
+        h = reg.histogram("apex_t_q_seconds", "h", ("op",),
+                          buckets=(1.0, 2.0, 4.0))
+        h.observe(0.5, op="a")
+        h.observe(3.0, op="b")
+        assert h.quantile(0.5, op="a") <= 1.0
+        assert h.quantile(0.5, op="b") > 2.0
+        assert math.isnan(h.quantile(0.5, op="c")
+                          ) if h.count(op="c") == 0 else True
+
+    def test_monotone_in_q(self, reg):
+        h = reg.histogram("apex_t_q_seconds",
+                          buckets=tuple(float(b) for b in
+                                        (1, 2, 4, 8, 16)))
+        rng = np.random.default_rng(0)
+        for v in rng.uniform(0.1, 20.0, 200):
+            h.observe(float(v))
+        qs = [h.quantile(q) for q in np.linspace(0, 1, 21)]
+        assert qs == sorted(qs)
 
 
 class TestConcurrency:
@@ -917,6 +996,7 @@ def test_acceptance_serving_drain_metrics_match_request_counts(events,
                  "serving_first_token", "serving_request_finished"):
         assert bridge.EVENTS_TOTAL.value(event=kind) == n_requests, kind
     assert bridge.SERVING_TTFT.count() == n_requests
+    assert bridge.SERVING_QUEUE_WAIT.count() == n_requests
     assert bridge.SERVING_PER_TOKEN.count() == n_requests
     assert bridge.SERVING_TOKENS_PER_S.value() > 0.0
 

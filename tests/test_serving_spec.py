@@ -308,7 +308,7 @@ def test_spec_respects_max_new_tokens_exactly(eng_pair):
 # wall-clock-derived event fields: the only payload allowed to differ
 # between a speculation-enabled and -disabled run of a sampled request
 _TIMING_FIELDS = ("ttft_s", "duration_s", "tokens_per_s", "per_token_ms",
-                  "time", "t_wall")
+                  "queue_wait_s", "time", "t_wall")
 
 
 def _capture_run(model, params, speculation):

@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Diff two bench JSON files and flag metric regressions.
+
+``bench.py`` writes one ``BENCH_r*.json`` per round; ``PERF_NOTES.md``
+records the story — but nothing *mechanically* compares two rounds, so
+a quiet 15% decode-latency regression rides along until a human reads
+the numbers.  This tool is that comparison:
+
+1. both files are flattened to dotted numeric leaves
+   (``serving.mixed.tokens_per_s_bucketed``, ``step_time_ms``, …);
+2. each shared leaf is classified by name — throughput-like (higher is
+   better: ``*tokens_per_s*``, ``*speedup*``, ``goodput``, ``mfu``, …),
+   latency-like (lower is better: ``*_ms``, ``*_seconds``, ``p99*``,
+   ``ttft*``, …), compile counts (lower is better, ZERO tolerance —
+   a new compile is a retrace, not noise), or informational (configs,
+   counts — reported only with ``--all``);
+3. a classified leaf that moved in the bad direction by more than the
+   tolerance (default 10%, ``--tol``; compile counts always 0) is a
+   **regression**; a block whose ``ok`` flipped true→false is too;
+4. any regression ⇒ exit 1 (wire it into CI between rounds).
+
+Usage::
+
+    python tools/bench_compare.py OLD.json NEW.json [--tol 0.10] [--all]
+    python tools/bench_compare.py            # newest two BENCH_r*.json
+
+Tier-1-covered by ``tests/test_bench_compare.py`` (golden fixtures for
+every classification family and the exit code).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterator, List, NamedTuple, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_TOLERANCE = 0.10
+
+# name-pattern classification, first match wins (checked against the
+# LAST dotted segment, lowercased).  Kept deliberately explicit: a
+# metric nobody classified is informational, never silently graded.
+_HIGHER_IS_BETTER = (
+    "tokens_per_s", "tokens_per_sec", "per_second", "per_sec",
+    "speedup", "goodput", "throughput", "tflops", "mfu",
+    "vs_baseline", "blocking_reduction", "capacity_ratio",
+)
+_LOWER_IS_BETTER = (
+    "_ms", "_s", "_seconds", "_us", "_ns", "p50", "p95", "p99",
+    "ttft", "tpot", "latency", "queue_wait", "deadline_misses",
+    "step_time", "duration",
+)
+_ZERO_TOLERANCE = ("compiles",)
+
+# leaves that are configuration/identity, not performance — never
+# graded even though some end in graded-looking suffixes.  Substrings
+# are matched against every dotted segment; the exact set matches the
+# final segment only (a sample count `n`, a workload period).
+_INFORMATIONAL = (
+    "config", "buckets", "prompt_lens", "n_chips", "attempts",
+    "seed", "fingerprint", "loss0", "loss_end", "params_m",
+)
+_INFORMATIONAL_EXACT = ("n", "burst", "steps", "period_s",
+                        "deadline_s", "shed", "offered", "completed")
+
+
+class Leaf(NamedTuple):
+    path: str          # dotted path
+    value: float
+
+
+class Finding(NamedTuple):
+    path: str
+    kind: str          # "regression" | "improvement" | "info" | "missing"
+    old: Optional[float]
+    new: Optional[float]
+    change: Optional[float]   # signed relative change, + == increased
+    detail: str
+
+
+def flatten(obj, prefix: str = "") -> Iterator[Leaf]:
+    """Numeric leaves (bools included — ``ok`` flags grade as 1/0) with
+    dotted paths; lists index by position; strings skipped."""
+    if isinstance(obj, bool):
+        yield Leaf(prefix, float(obj))
+    elif isinstance(obj, (int, float)):
+        yield Leaf(prefix, float(obj))
+    elif isinstance(obj, dict):
+        for k in sorted(obj):
+            key = f"{prefix}.{k}" if prefix else str(k)
+            yield from flatten(obj[k], key)
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from flatten(v, f"{prefix}[{i}]")
+
+
+def _segment_class(seg: str) -> Optional[str]:
+    if seg == "ok":
+        return "exact_higher"
+    if seg == "value":
+        # the bench headline ({"metric": ..., "value": ...}) is a
+        # tokens/s rate by construction
+        return "higher"
+    if any(p in seg for p in _ZERO_TOLERANCE):
+        return "exact"
+    if any(p in seg for p in _HIGHER_IS_BETTER):
+        return "higher"
+    tokens = seg.split("_")
+    for p in _LOWER_IS_BETTER:
+        if p.startswith("_"):
+            # unit suffixes match whole underscore tokens ("decode_ms
+            # _per_token" is ms-denominated; "rps" is not "s")
+            if p[1:] in tokens:
+                return "lower"
+        elif p in seg:
+            return "lower"
+    return None
+
+
+def classify(path: str) -> Optional[str]:
+    """``"higher"`` / ``"lower"`` / ``"exact"`` (zero-tolerance) /
+    ``None`` (informational).  Matched per dotted segment, innermost
+    first, so a labeled series (``throughput_tokens_per_s.4``)
+    classifies by its family name."""
+    segments = [re.sub(r"\[\d+\]$", "", s)
+                for s in path.lower().split(".")]
+    if any(s in seg for s in _INFORMATIONAL for seg in segments):
+        return None
+    if segments[-1] in _INFORMATIONAL_EXACT:
+        return None
+    for seg in reversed(segments):
+        got = _segment_class(seg)
+        if got is not None:
+            return got
+    return None
+
+
+def _tolerance_for(path: str, tol: float,
+                   overrides: Dict[str, float]) -> float:
+    for pattern, t in overrides.items():
+        if re.search(pattern, path):
+            return t
+    return tol
+
+
+def compare(old: dict, new: dict, *, tol: float = DEFAULT_TOLERANCE,
+            tol_overrides: Optional[Dict[str, float]] = None
+            ) -> List[Finding]:
+    """All findings, regressions first.  ``tol_overrides`` maps regex
+    patterns (matched with ``re.search`` against the dotted path) to a
+    per-metric relative tolerance."""
+    tol_overrides = tol_overrides or {}
+    old_leaves = {leaf.path: leaf.value for leaf in flatten(old)}
+    new_leaves = {leaf.path: leaf.value for leaf in flatten(new)}
+    findings: List[Finding] = []
+    for path in sorted(old_leaves):
+        kind = classify(path)
+        o = old_leaves[path]
+        if path not in new_leaves:
+            if kind is not None:
+                findings.append(Finding(path, "missing", o, None, None,
+                                        "graded metric absent from the "
+                                        "new file"))
+            continue
+        n = new_leaves[path]
+        if kind is None:
+            if n != o:
+                findings.append(Finding(path, "info", o, n, None,
+                                        "informational change"))
+            continue
+        change = (n - o) / abs(o) if o != 0 else (0.0 if n == o
+                                                  else float("inf"))
+        limit = (0.0 if kind.startswith("exact")
+                 else _tolerance_for(path, tol, tol_overrides))
+        if kind in ("higher", "exact_higher"):
+            bad, good = change < -limit, change > limit
+        else:                                    # lower / exact
+            bad, good = change > limit, change < -limit
+        if bad:
+            findings.append(Finding(
+                path, "regression", o, n, change,
+                f"{'↑' if change > 0 else '↓'}{abs(change):.1%} worse "
+                f"(tolerance {limit:.0%}, "
+                f"{'higher' if 'higher' in kind else 'lower'} is "
+                f"better)"))
+        elif good:
+            findings.append(Finding(path, "improvement", o, n, change,
+                                    f"{abs(change):.1%} better"))
+    order = {"regression": 0, "missing": 1, "improvement": 2, "info": 3}
+    findings.sort(key=lambda f: (order[f.kind], f.path))
+    return findings
+
+
+def newest_bench_files(root: str = REPO) -> Tuple[str, str]:
+    """The newest two ``BENCH_r*.json`` by round number (old, new)."""
+    paths = glob.glob(os.path.join(root, "BENCH_r*.json"))
+
+    def round_no(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+
+    paths.sort(key=round_no)
+    if len(paths) < 2:
+        raise FileNotFoundError(
+            f"need two BENCH_r*.json under {root} to compare, "
+            f"found {len(paths)}")
+    return paths[-2], paths[-1]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("old", nargs="?", help="baseline bench JSON "
+                    "(default: second-newest BENCH_r*.json)")
+    ap.add_argument("new", nargs="?", help="candidate bench JSON "
+                    "(default: newest BENCH_r*.json)")
+    ap.add_argument("--tol", type=float, default=DEFAULT_TOLERANCE,
+                    help="relative tolerance for graded metrics "
+                    "(default %(default)s)")
+    ap.add_argument("--all", action="store_true",
+                    help="also print informational changes")
+    args = ap.parse_args(argv)
+    if (args.old is None) != (args.new is None):
+        ap.error("pass both files or neither")
+    if args.old is None:
+        args.old, args.new = newest_bench_files()
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    findings = compare(old, new, tol=args.tol)
+    regressions = [f for f in findings if f.kind in ("regression",
+                                                     "missing")]
+    shown = (findings if args.all
+             else [f for f in findings if f.kind != "info"])
+    print(f"comparing {os.path.basename(args.old)} -> "
+          f"{os.path.basename(args.new)} (tol {args.tol:.0%})")
+    for f in shown:
+        fmt = (lambda v: "-" if v is None else f"{v:g}")
+        print(f"[{f.kind:>11}] {f.path}: {fmt(f.old)} -> {fmt(f.new)}  "
+              f"{f.detail}")
+    print(f"{len(regressions)} regression(s), "
+          f"{sum(f.kind == 'improvement' for f in findings)} "
+          f"improvement(s)")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,7 +16,11 @@ alert on what they can look up).  This lint pins all three statically:
 3. each name is registered at exactly ONE call site (declare the
    instrument once at module level, import the object everywhere else);
 4. each name appears in ``docs/api/observability.md`` (regenerate via
-   ``tools/gen_api_docs.py`` after editing its PAGE_PROLOGUE table).
+   ``tools/gen_api_docs.py`` after editing its PAGE_PROLOGUE table);
+5. the reverse: every row of the doc's metric-inventory table names a
+   metric that is actually registered — a deleted metric must take its
+   documentation row with it (operators alert on what they can look
+   up, and a stale row is an alert that can never fire).
 
 Run directly (``python tools/check_metrics.py``) or through tier-1
 (``tests/test_lint_metrics.py``).  Scope is ``apex_tpu/`` only: tests
@@ -103,6 +107,22 @@ def collect() -> List[Registration]:
     return regs
 
 
+# an inventory-table row: first cell is the backticked metric name,
+# optionally with a {label} suffix inside the backticks
+_DOC_ROW_RE = re.compile(r"^\|\s*`(apex_[a-z0-9_]+)[^`]*`\s*\|")
+
+
+def documented_inventory(doc_text: str) -> List[tuple[str, int]]:
+    """``(metric name, line number)`` for every inventory-table row in
+    the docs page (prose mentions are not rows and are not scanned)."""
+    out = []
+    for lineno, line in enumerate(doc_text.splitlines(), start=1):
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            out.append((m.group(1), lineno))
+    return out
+
+
 def check(regs: List[Registration], doc_text: str | None) -> List[str]:
     """All violations as human-readable messages (empty == clean)."""
     problems: List[str] = []
@@ -146,6 +166,14 @@ def check(regs: List[Registration], doc_text: str | None) -> List[str]:
                     f"{os.path.relpath(DOC, REPO)} (add it to the "
                     f"inventory table in gen_api_docs.py PAGE_PROLOGUE "
                     f"and regenerate)")
+        # the reverse direction: no stale inventory rows
+        for name, lineno in documented_inventory(doc_text):
+            if name not in by_name:
+                problems.append(
+                    f"{os.path.relpath(DOC, REPO)}:{lineno}: inventory "
+                    f"row documents {name!r} but no registration "
+                    f"exists under apex_tpu/ — remove the row from "
+                    f"gen_api_docs.py PAGE_PROLOGUE and regenerate")
     return problems
 
 

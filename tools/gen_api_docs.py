@@ -93,10 +93,12 @@ PAGES = {
         "apex_tpu.serving.paged_kv_cache",
         "apex_tpu.serving.engine", "apex_tpu.serving.draft",
         "apex_tpu.serving.prefix_cache",
-        "apex_tpu.serving.scheduler", "apex_tpu.serving.weights",
+        "apex_tpu.serving.scheduler", "apex_tpu.serving.loadgen",
+        "apex_tpu.serving.weights",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
         "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
+        "apex_tpu.obs.request_trace", "apex_tpu.obs.slo",
         "apex_tpu.obs.bridge",
     ]),
     "utils": ("Utilities", [
@@ -785,6 +787,48 @@ cold-vs-warm prefix-cache admissions for 8 shared-prompt streams
 overlap, asserted against the harness's own measured noise
 floor; streams token-identical; restore compiles bounded by
 the prefill bucket table).
+
+## Open-loop load generation (`serving.loadgen`)
+
+The bench's staggered streams are *closed-loop* (a new request submits
+only when the driver is ready) — they measure drain rate, never
+queueing.  Serving comparisons in the literature drive the system at a
+controlled **offered load** instead; `serving.loadgen` is that driver,
+deterministic end to end:
+
+- **Arrival processes**: `uniform_arrivals(n, rate)`,
+  `poisson_arrivals(n, rate, seed)` (seeded exponential gaps — the
+  same seed is the same schedule, bit for bit), and
+  `burst_arrivals(n, burst, period_s, spacing_s)` (burst trains, the
+  workload SLO scheduling is graded by).
+- **Prompt mixes**: `shared_prefix_prompts` (one system prompt + unique
+  tails — the prefix-cache hit class), `zero_overlap_prompts` (its
+  no-regression class), `mixed_length_prompts` (the bench's
+  short-skewed `LENGTH_SKEW_FRACTIONS` recipe).
+- **`OpenLoopWorkload`** zips requests + arrival offsets + per-request
+  completion deadlines; `schedule_fingerprint()` digests the whole
+  schedule (offsets, token ids, generation config) — equal
+  fingerprints ⇒ identical token streams, the bit-reproducibility
+  witness `bench.py serving_slo` asserts.
+- **`LoadGenerator(scheduler, workload)`** submits each request the
+  moment its offset comes due on the *scheduler's own clock*, sheds
+  arrivals at `QueueFull` (open-loop: the arrival process never slows
+  down for the system; shed requests are charged against goodput), and
+  steps the scheduler until the workload drains.  With
+  `clock=VirtualClock()` on the scheduler and `step_time_s=` on the
+  generator the run is sleep-free and fully deterministic — every
+  latency an exact multiple of the virtual step (the tier-1 timing
+  tests).  A deadline-carrying run publishes
+  `apex_serving_goodput_ratio`; without deadlines the metric stream is
+  untouched.
+
+Pair with `apex_tpu.obs.RequestTraceRecorder` (per-request lifecycle
+records off the event stream) and `apex_tpu.obs.build_report`
+(p50/p95/p99 TTFT / TPOT / queue-wait + goodput) — the measurement
+layer the ROADMAP's SLO-aware-scheduling work is graded by.
+`bench.py`'s `serving_slo` block drives a seeded bursty workload at
+~1× and ~2× the measured sustainable rate and records p99 TTFT, TPOT
+and goodput at both loads in `PERF_NOTES.md`.
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -834,6 +878,8 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_checkpoint_backpressure_total` | counter | async saves that joined a still-running previous write |
 | `apex_checkpoints_rejected_total` | counter | `checkpoint_rejected` events |
 | `apex_serving_ttft_seconds` | histogram | `serving_first_token` events |
+| `apex_serving_queue_wait_seconds` | histogram | `serving_request_admitted` events (submit → slot admission; the queueing component of TTFT) |
+| `apex_serving_goodput_ratio` | gauge | `serving.loadgen` (requests meeting their deadline / offered, for the most recent deadline-carrying open-loop run) |
 | `apex_serving_prefill_duration_seconds{bucket}` | histogram | `serving_prefill_chunk` events (label = bucket size; bounded by the engine's bucket table) |
 | `apex_serving_decode_per_token_seconds` | histogram | `serving_request_finished` events |
 | `apex_serving_tokens_per_second` | gauge | last finished request |
@@ -899,6 +945,45 @@ every instrumented subsystem does) subscribes a sink that counts every
 event kind, stamps the active span, and runs per-kind handlers for
 payloads carrying real measurements.  Zero call-site churn: existing
 `emit_event` callers became metrics sources without edits.
+
+## Request-level serving traces (`obs.request_trace`)
+
+`RequestTraceRecorder` is a second event sink (same registry, same
+zero call-site churn) that folds the serving event stream back into
+**one lifecycle record per request**: queued → admitted →
+prefix-hit/restore → each prefill chunk → first token → decode →
+finished, with exact phase boundaries on an injectable clock
+(`queue_wait_s` / `prefill_s` / `decode_s` sum to `total_s` within
+1 µs — the four stamps are shared), slot id, and
+speculation / prefix-cache / paged-aliasing annotations matched from
+the event payloads.  Default-off like spans: with no recorder
+installed nothing runs and the event/metric stream is untouched
+(tier-1 pins the identity **and** an instrumented-vs-bare scheduler
+step bound ≤ 1.10× with a recorder installed).  Exports follow the
+`TraceRecorder` conventions — bounded memory (`max_requests`, drops
+counted in `otherData`), `export(path)` writes a Perfetto-loadable
+Chrome trace with **one named track per request** (phases and
+chunk/verify slices nested by containment), `export_jsonl(path)`
+writes one JSON record per request for offline analysis, both through
+the shared atomic-write + non-finite-sanitizing machinery.
+
+## SLO reports (`obs.slo`)
+
+`build_report(records, offered=..., deadlines=..., duration_s=...)`
+folds a recorder's records into an `SLOReport`: **nearest-rank**
+p50/p95/p99 (+ mean/min/max) over the exact per-request samples for
+TTFT (submit → first token), TPOT (decode seconds per generated token
+past the first), queue wait, and end-to-end latency, plus goodput
+(requests meeting their deadline / requests *offered* — shed and
+unfinished requests count against it) and throughput.
+`SLOReport.to_dict()` is a stable rounded JSON-ready dict (the
+`bench.py serving_slo` block's payload; diffable by
+`tools/bench_compare.py`).  `Histogram.quantile(q)` gives the
+scrape-side bucket-interpolated estimate (exact at bucket edges,
+error bounded by one bucket width), and
+`crosscheck_quantiles(samples, histogram)` proves the two views agree
+bucket-for-bucket — the in-process dashboard and the offline report
+cannot silently diverge.
 """,
 }
 
@@ -1293,6 +1378,55 @@ stale sample (a stopped watchdog reports the `-1` no-live-beat
 sentinel).  With
 no exporter attached the whole layer costs a lock + dict write per
 update (`bench.py` `obs` block).
+
+Load-test your server and read the SLO report — throughput at drain
+rate says nothing about latency under load; drive the scheduler
+**open-loop** at a controlled offered load, record every request's
+lifecycle, and read the percentiles
+([serving page](api/serving.md), [obs page](api/observability.md)):
+
+```python
+from apex_tpu import obs, serving as sv
+
+# 1. a deterministic bursty workload: 64 shared-prefix requests in
+#    bursts of 4, ~8 requests/s offered, 2 s completion deadline
+wl = sv.make_workload(
+    sv.shared_prefix_prompts(64, shared_len=96, suffix_len=16,
+                             vocab=cfg.vocab_size, seed=7),
+    sv.burst_arrivals(64, burst=4, period_s=0.5),
+    max_new_tokens=32, deadline_s=2.0)
+
+# 2. record request lifecycles off the event stream (an event sink —
+#    no scheduler changes; omit it and nothing runs at all)
+with obs.recording_requests() as rec:
+    out = sv.LoadGenerator(sched, wl).run()     # sheds at QueueFull
+
+# 3. the SLO report: exact nearest-rank percentiles per phase
+#    (deadlines enforced from ARRIVAL — pass out.arrivals)
+report = obs.build_report(rec.records(), offered=out.offered,
+                          deadlines=out.deadlines,
+                          arrivals=out.arrivals,
+                          duration_s=out.duration_s)
+print(report.to_dict())   # p50/p95/p99 ttft_s / tpot_s /
+                          # queue_wait_s, goodput, throughput
+
+# 4. where did a slow request's time go?  one named track per request
+rec.export("/tmp/requests.trace.json")   # open in ui.perfetto.dev
+rec.export_jsonl("/tmp/requests.jsonl")  # offline analysis
+```
+
+Same seed, same schedule, bit for bit
+(`wl.schedule_fingerprint()` digests offsets + token ids + generation
+config); under a `VirtualClock` + `step_time_s=` the whole run is
+sleep-free and every latency deterministic — the tier-1 tests assert
+exact TTFT values.  Goodput (met deadlines / offered) rides the
+`apex_serving_goodput_ratio` gauge, queue wait feeds
+`apex_serving_queue_wait_seconds`, and `Histogram.quantile(q)`
+cross-checks the scrape-side estimates against the exact samples.
+`bench.py`'s `serving_slo` block runs this recipe at ~1× and ~2× the
+measured sustainable load; compare rounds with
+`python tools/bench_compare.py OLD.json NEW.json` (exit 1 on any
+metric regression beyond tolerance).
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

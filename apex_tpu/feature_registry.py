@@ -80,3 +80,7 @@ register("obs", "metrics registry + span tracing + Prometheus/Chrome-trace expor
 register("serving_slo", "request-level lifecycle traces + deterministic open-loop "
          "load generation + SLO percentile reports (TTFT/TPOT/queue-wait/goodput)",
          False, "host-side stdlib")
+register("serving_policy", "serving control plane: priority classes with lossless "
+         "(bit-exact) preemption, cancellation, deadline shedding, per-tenant "
+         "weighted-round-robin fairness + serving chaos injection",
+         False, "host scheduler + existing capture/restore/alias programs")

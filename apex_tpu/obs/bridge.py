@@ -152,6 +152,22 @@ SERVING_BLOCK_COW = metrics.counter(
     "apex_serving_block_cow_total",
     "copy-on-write block copies (a write targeted a block whose "
     "refcount exceeded one — sharers stay bit-isolated)")
+SERVING_PREEMPTED = metrics.counter(
+    "apex_serving_preempted_total",
+    "DECODE streams losslessly preempted by a higher-priority "
+    "admission (each resumes bit-exactly later)")
+SERVING_CANCELLED = metrics.counter(
+    "apex_serving_cancelled_total",
+    "requests cancelled by the caller (slot/blocks/pins released; "
+    "partial output kept in the result)")
+SERVING_SHED = metrics.counter(
+    "apex_serving_shed_total",
+    "queued or suspended requests shed at an expired deadline before "
+    "spending further prefill budget (charged against goodput)")
+SERVING_TENANT_INFLIGHT = metrics.gauge(
+    "apex_serving_tenant_inflight",
+    "active decode/prefill streams per tenant (refreshed per scheduler "
+    "step while a scheduling policy is enabled)", ("tenant",))
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -256,6 +272,18 @@ def _on_serving_block_cow(event: dict) -> None:
         SERVING_BLOCK_COW.inc(blocks)
 
 
+def _on_serving_request_preempted(event: dict) -> None:
+    SERVING_PREEMPTED.inc()
+
+
+def _on_serving_request_cancelled(event: dict) -> None:
+    SERVING_CANCELLED.inc()
+
+
+def _on_serving_request_shed(event: dict) -> None:
+    SERVING_SHED.inc()
+
+
 def _on_serving_request_finished(event: dict) -> None:
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
@@ -282,6 +310,9 @@ _HANDLERS = {
     "serving_block_alias": _on_serving_block_alias,
     "serving_block_cow": _on_serving_block_cow,
     "serving_spec_verify": _on_serving_spec_verify,
+    "serving_request_preempted": _on_serving_request_preempted,
+    "serving_request_cancelled": _on_serving_request_cancelled,
+    "serving_request_shed": _on_serving_request_shed,
     "serving_request_finished": _on_serving_request_finished,
 }
 

@@ -95,6 +95,9 @@ class RequestRecord:
     spec: Dict[str, int] = dataclasses.field(default_factory=dict)
     prefix: Optional[dict] = None      # {"hit": bool, ...} when caching on
     alias: Optional[dict] = None       # paged zero-copy block reuse
+    # control-plane annotations (empty/zero without a policy)
+    preemptions: int = 0               # lossless suspend/resume cycles
+    preempts: List[dict] = dataclasses.field(default_factory=list)
     # the scheduler's own clock measurements (cross-check material)
     scheduler_ttft_s: Optional[float] = None
     scheduler_queue_wait_s: Optional[float] = None
@@ -168,6 +171,8 @@ class RequestRecord:
             "chunks": list(self.chunks),
             "spec": dict(self.spec),
             "prefix": self.prefix, "alias": self.alias,
+            "preemptions": self.preemptions,
+            "preempts": list(self.preempts),
             "scheduler_ttft_s": self.scheduler_ttft_s,
             "scheduler_queue_wait_s": self.scheduler_queue_wait_s,
             "per_token_ms": self.per_token_ms,
@@ -328,6 +333,32 @@ class RequestTraceRecorder:
                     dur = self._num(event, "duration_s")
                     st.spec.setdefault("verifies", []).append(
                         {"duration_s": dur, "t_end": now})
+            elif kind == "serving_request_preempted":
+                st = self._get(rid, create=False)
+                if st is not None:
+                    st.preemptions += 1
+                    st.preempts.append({"t_preempted": now,
+                                        "t_resumed": None})
+            elif kind == "serving_request_resumed":
+                st = self._get(rid, create=False)
+                if st is not None and st.preempts and (
+                        st.preempts[-1].get("t_resumed") is None):
+                    st.preempts[-1]["t_resumed"] = now
+            elif kind in ("serving_request_cancelled",
+                          "serving_request_shed"):
+                # a non-served terminal: close the record (it will be
+                # `complete` only if it reached DECODE before dying —
+                # an incomplete record is counted, never distributed)
+                st = self._open.pop(rid, None)
+                if st is None:
+                    return
+                st.t_finished = now
+                st.finish_reason = ("cancelled"
+                                    if kind.endswith("cancelled")
+                                    else "shed")
+                nt = self._num(event, "new_tokens")
+                st.new_tokens = int(nt) if nt is not None else None
+                self._done.append(st)
             elif kind == "serving_request_finished":
                 st = self._open.pop(rid, None)
                 if st is None:
@@ -414,6 +445,14 @@ class RequestTraceRecorder:
                    scheduler_ttft_s=st.scheduler_ttft_s)
             slice_("decode", tid, st.t_first, st.t_finished,
                    tpot_s=st.tpot_s, per_token_ms=st.per_token_ms)
+            for gap in st.preempts:
+                # a suspension gap inside the decode phase; a stream
+                # cancelled/shed while suspended never resumed — its
+                # gap runs to the terminal stamp
+                slice_("preempted", tid, gap.get("t_preempted"),
+                       (gap.get("t_resumed")
+                        if gap.get("t_resumed") is not None
+                        else st.t_finished))
             for chunk in st.chunks:
                 dur = chunk.get("duration_s")
                 end = chunk.get("t_end")

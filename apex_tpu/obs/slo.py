@@ -194,6 +194,12 @@ def build_report(records: Sequence, *,
     ``{"ttft" | "queue_wait" | "tpot": Histogram}`` to attach a
     :func:`crosscheck_quantiles` block per series (meaningful when the
     histograms observed exactly this run — reset the registry first).
+
+    Control-plane terminals: a record whose ``finish_reason`` is
+    ``"cancelled"`` or ``"shed"`` never counts toward goodput (service
+    was not delivered in full), though a cancelled-mid-decode record
+    with every stamp still contributes its real TTFT/queue-wait
+    samples — those latencies genuinely happened.
     """
     done = [st for st in records if st.complete]
     ttft = [st.ttft_s for st in done]
@@ -216,6 +222,12 @@ def build_report(records: Sequence, *,
         for rid, deadline in deadlines.items():
             st = by_rid.get(rid)
             if st is None:
+                continue
+            if st.finish_reason in ("cancelled", "shed"):
+                # the record closed, but service was never delivered in
+                # full — a cancelled stream that "finished" early must
+                # not inflate goodput (mirrors the load generator's
+                # SERVED_REASONS accounting)
                 continue
             if deadline is None:
                 met += 1

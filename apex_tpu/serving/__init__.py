@@ -61,6 +61,16 @@ head matmul), its amp policies, and its resilience checkpoints:
   structured telemetry (queue depth, prefill backlog, per-chunk
   dispatch time, TTFT, per-token latency, tokens/s) via
   ``emit_event``.
+- :mod:`.policy` — the **serving control plane** knob
+  (``ContinuousBatchingScheduler(..., policy=SchedulingPolicy(...))``):
+  priority classes with **lossless preemption** (a low-priority DECODE
+  stream is evicted by capturing its cache state — dense bucketed
+  snapshot or paged block references — and resumed *bit-exactly*
+  later: same tokens, same f32 logits), request ``cancel(rid)``,
+  arrival-relative deadline load shedding at admission and mid-queue,
+  and per-tenant smooth-weighted-round-robin admission with in-flight
+  caps.  Default off: a scheduler without ``policy=`` is byte-for-byte
+  the FIFO scheduler.
 - :mod:`.loadgen` — deterministic **open-loop workload generation**:
   seeded arrival processes (uniform / Poisson / burst trains), the
   canonical prompt mixes (shared-prefix fleet, zero-overlap, the
@@ -133,13 +143,16 @@ from apex_tpu.serving.paged_kv_cache import (
     PagedKVCache,
     init_paged_cache,
 )
+from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from apex_tpu.serving.scheduler import (
+    SERVED_REASONS,
     ContinuousBatchingScheduler,
     QueueFull,
     Request,
     RequestPhase,
     RequestResult,
+    SchedulerStalled,
 )
 from apex_tpu.serving.weights import load_serving_params
 
@@ -173,6 +186,10 @@ __all__ = [
     "Request",
     "RequestPhase",
     "RequestResult",
+    "SchedulerStalled",
+    "SchedulingPolicy",
+    "WeightedRoundRobin",
+    "SERVED_REASONS",
     "LoadGenerator",
     "LoadgenResult",
     "OpenLoopWorkload",

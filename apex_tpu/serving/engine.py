@@ -812,6 +812,71 @@ class DecodeEngine:
         return self._read(self._cache, np.int32(slot), np.int32(start),
                           n=stop - start)
 
+    def capture_slot(self, slot: int) -> tuple[np.ndarray, np.ndarray, int]:
+        """Snapshot a live slot's ENTIRE valid K/V to the host —
+        ``(k, v, length)`` with ``k`` / ``v`` of shape ``[layers,
+        length, kv_heads, head_dim]`` — the lossless-preemption capture
+        primitive: :meth:`restore_prefix` of exactly these arrays into
+        a free slot reproduces the slot's cache state bit for bit (the
+        bytes ARE the cache's bytes), so a preempted DECODE stream
+        resumes with identical f32 logits.
+
+        The snapshot runs as :meth:`read_region` spans decomposed over
+        the *prefill bucket table* (greedy largest-bucket-first, the
+        sub-floor tail overlap-read inside a floor-sized span), so the
+        read program's compile count stays bounded by
+        ``len(prefill_buckets)`` plus at most ``prefill_buckets[0] - 1``
+        sub-floor whole-slot extents — no new program family
+        (:meth:`capture_compiles` is the witness).  Dense engines only:
+        a paged slot is captured by *reference*
+        (:meth:`slot_block_ids` + pool refcounts), never by copy.
+        """
+        self._check_slot(slot)
+        if self._pager is not None:
+            raise ValueError(
+                "capture_slot on a paged engine — capture by reference "
+                "instead (slot_block_ids + block_pool.ref; resume via "
+                "alias_prefix)")
+        length = int(self._lengths_host[slot])
+        if length < 1:
+            raise ValueError(f"capture of empty slot {slot}")
+        buckets = self.prefill_buckets
+        parts_k, parts_v = [], []
+        pos = 0
+        while pos < length:
+            rem = length - pos
+            if length < buckets[0]:
+                # whole slot shorter than the smallest bucket: one
+                # sub-floor read (extent < buckets[0], bounded)
+                lo, hi = 0, length
+            elif rem >= buckets[0]:
+                b = max(x for x in buckets if x <= rem)
+                lo, hi = pos, pos + b
+            else:
+                # sub-floor tail of a longer slot: overlap-read the
+                # last floor-sized span and trim the replayed rows
+                lo, hi = length - buckets[0], length
+            k_span, v_span = self.read_region(slot, lo, hi)
+            skip = pos - lo                    # rows already captured
+            parts_k.append(np.asarray(k_span)[:, skip:])
+            parts_v.append(np.asarray(v_span)[:, skip:])
+            pos = hi
+        k = parts_k[0] if len(parts_k) == 1 else np.concatenate(
+            parts_k, axis=1)
+        v = parts_v[0] if len(parts_v) == 1 else np.concatenate(
+            parts_v, axis=1)
+        return k, v, length
+
+    def capture_compiles(self) -> int:
+        """Number of distinct compiles of the region-read program
+        (shared by prefix-cache capture and preemption capture) —
+        bounded by the distinct span extents those callers use
+        (block-granular capture: ``ceil(prefill_len / block_size)``;
+        preemption: the prefill bucket table plus sub-floor whole-slot
+        lengths).  Zero until the first read — the witness that a run
+        with neither feature compiles nothing extra."""
+        return compile_count(self._read)
+
     def restore_prefix(self, slot: int, kv, length: int) -> None:
         """Place previously captured K/V back into a free slot: after
         the call the slot holds ``length`` cached tokens, bit-for-bit

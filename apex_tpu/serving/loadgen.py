@@ -54,6 +54,7 @@ import numpy as np
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.serving.scheduler import (
+    SERVED_REASONS,
     ContinuousBatchingScheduler,
     QueueFull,
     Request,
@@ -255,7 +256,10 @@ class OpenLoopWorkload:
                            req.max_new_tokens, req.eos_id,
                            req.temperature, req.top_k, req.seed,
                            float(off),
-                           None if dl is None else float(dl))).encode())
+                           None if dl is None else float(dl),
+                           req.priority, req.tenant,
+                           None if req.deadline_s is None
+                           else float(req.deadline_s))).encode())
         return h.hexdigest()
 
 
@@ -266,17 +270,30 @@ def make_workload(prompts: Sequence[Sequence[int]],
                   eos_id: Optional[int] = None,
                   temperature: float = 0.0, top_k: int = 0,
                   seed: int = 0,
-                  rid_prefix: str = "lg") -> OpenLoopWorkload:
+                  rid_prefix: str = "lg",
+                  priorities: Optional[Sequence[int]] = None,
+                  tenants: Optional[Sequence[str]] = None
+                  ) -> OpenLoopWorkload:
     """Zip a prompt mix with an arrival table into an
     :class:`OpenLoopWorkload` (one shared ``deadline_s`` / generation
-    config; build the dataclass directly for per-request variety)."""
+    config; build the dataclass directly for per-request variety).
+
+    ``deadline_s`` rides both the workload (goodput accounting, from
+    arrival) and each :class:`Request` (so a ``policy=`` scheduler can
+    shed expired queued requests — a FIFO scheduler ignores it).
+    ``priorities`` / ``tenants`` optionally assign per-request control
+    -plane fields (cycled if shorter than the prompt list)."""
     if len(prompts) != len(arrivals):
         raise ValueError(f"{len(prompts)} prompts vs {len(arrivals)} "
                          f"arrivals")
     requests = tuple(
         Request(f"{rid_prefix}{i}", list(p), max_new_tokens=max_new_tokens,
                 eos_id=eos_id, temperature=temperature, top_k=top_k,
-                seed=seed + i)
+                seed=seed + i, deadline_s=deadline_s,
+                priority=(0 if priorities is None
+                          else int(priorities[i % len(priorities)])),
+                tenant=("default" if tenants is None
+                        else str(tenants[i % len(tenants)])))
         for i, p in enumerate(prompts))
     return OpenLoopWorkload(requests=requests,
                             arrivals=tuple(float(a) for a in arrivals),
@@ -292,20 +309,24 @@ class LoadgenResult:
     step boundary's submit lag can never quietly extend a deadline."""
 
     offered: int
-    completed: int
+    completed: int                         # results with FULL service
     rejected: List[str]                    # shed at QueueFull, in order
     results: Dict[str, RequestResult]      # rid -> scheduler result
     deadlines: Dict[str, Optional[float]]  # rid -> deadline from arrival
     arrivals: Dict[str, float]             # rid -> absolute arrival stamp
-    met_deadline: Dict[str, bool]          # rid -> completed within it
+    met_deadline: Dict[str, bool]          # rid -> served within it
     duration_s: float
     steps: int
 
     @property
     def goodput(self) -> Optional[float]:
-        """Requests meeting their deadline / offered (None when the
-        workload carries no deadlines — goodput is then undefined, not
-        1.0)."""
+        """Requests *served in full* within their deadline / offered
+        (None when the workload carries no deadlines — goodput is then
+        undefined, not 1.0).  A cancelled or policy-shed request has a
+        result but delivered partial or no service
+        (:data:`~apex_tpu.serving.scheduler.SERVED_REASONS`), so it
+        can never count as met — finishing early by giving up is not
+        goodput."""
         if all(d is None for d in self.deadlines.values()):
             return None
         return sum(self.met_deadline.values()) / max(self.offered, 1)
@@ -326,12 +347,23 @@ class LoadGenerator:
     whose offset has come due *before* each step — open-loop: arrivals
     never wait for capacity, and a full queue sheds the request
     (recorded in ``rejected``, charged against goodput).
+
+    ``step_hook`` (optional, ``hook(step_index, scheduler)``) fires
+    after every scheduler step — the serving-chaos injection point:
+    :class:`~apex_tpu.resilience.fault_injection.SlowDecodeStep`
+    inflates chosen steps on the virtual clock,
+    :class:`~apex_tpu.resilience.fault_injection.StallStream` /
+    :class:`~apex_tpu.resilience.fault_injection.CancelStorm` drive
+    deterministic cancellations mid-run.  ``None`` (the default) runs
+    exactly the pre-hook loop.
     """
 
     def __init__(self, scheduler: ContinuousBatchingScheduler,
                  workload: OpenLoopWorkload, *,
                  step_time_s: Optional[float] = None,
-                 max_steps: Optional[int] = None):
+                 max_steps: Optional[int] = None,
+                 step_hook: Optional[Callable[
+                     [int, ContinuousBatchingScheduler], None]] = None):
         clock = scheduler.clock
         if step_time_s is not None:
             if step_time_s <= 0:
@@ -346,6 +378,7 @@ class LoadGenerator:
         self.workload = workload
         self.step_time_s = step_time_s
         self.max_steps = max_steps
+        self.step_hook = step_hook
         self._clock: Callable[[], float] = clock
 
     def run(self) -> LoadgenResult:
@@ -360,7 +393,16 @@ class LoadGenerator:
                    fingerprint=wl.schedule_fingerprint(),
                    offered_rps=(None if wl.offered_rps == float("inf")
                                 else round(wl.offered_rps, 6)))
-        while i < n or sched.queue_depth or sched.active_count:
+        def pending() -> bool:
+            # suspended (preempted) streams are live work: a policy
+            # scheduler may hold a victim mid-decode while its
+            # preemptor finishes — stopping then would orphan the
+            # victim without a result (and a later close() would
+            # refuse).  FIFO schedulers always report 0 suspended.
+            return bool(sched.queue_depth or sched.active_count
+                        or sched.suspended_count)
+
+        while i < n or pending():
             now = self._clock() - t_start
             while i < n and wl.arrivals[i] <= now + 1e-12:
                 req = wl.requests[i]
@@ -374,11 +416,17 @@ class LoadGenerator:
                     emit_event("loadgen_request_shed", rid=req.rid,
                                queue_depth=sched.queue_depth)
                 i += 1
-            if i >= n and not (sched.queue_depth or sched.active_count):
+            if i >= n and not pending():
                 break                       # everything shed or done
             t_before = self._clock()
             sched.step()
             steps += 1
+            if self.step_hook is not None:
+                # chaos injection point: the hook may inflate the clock
+                # (SlowDecodeStep), cancel requests (StallStream /
+                # CancelStorm), or inspect state — deterministic by
+                # step index
+                self.step_hook(steps - 1, sched)
             if self.step_time_s is not None:
                 self._clock.advance(self.step_time_s)
             elif (self._clock() == t_before and i < n
@@ -398,11 +446,16 @@ class LoadGenerator:
         arrivals = {r.rid: t_start + off
                     for r, off in zip(wl.requests, wl.arrivals)}
         met = {}
+        served = 0
         for req, deadline in zip(wl.requests, wl.deadlines):
             res = results.get(req.rid)
-            if res is None:
+            # only FULL service can meet a deadline: a cancelled or
+            # policy-shed result exists but delivered nothing it
+            # promised — counting it as met would reward giving up
+            if res is None or res.finish_reason not in SERVED_REASONS:
                 met[req.rid] = False
                 continue
+            served += 1
             # enforced from ARRIVAL, not submission: submits happen at
             # step boundaries, so a request due mid-step is submitted
             # late — that lag must tighten its remaining budget, never
@@ -411,7 +464,7 @@ class LoadGenerator:
             met[req.rid] = bool(
                 deadline is None
                 or finish_abs - arrivals[req.rid] <= deadline)
-        out = LoadgenResult(offered=n, completed=len(results),
+        out = LoadgenResult(offered=n, completed=served,
                             rejected=rejected, results=results,
                             deadlines=deadlines, arrivals=arrivals,
                             met_deadline=met,

@@ -75,10 +75,32 @@ boundaries:
   refresh every step, so a Prometheus scrape sees live state
   regardless of ``log_interval``.
 
+- **Control plane** (opt-in via ``policy=SchedulingPolicy(...)`` —
+  :mod:`apex_tpu.serving.policy`): priority classes with **lossless
+  preemption** (a queued request may evict a strictly lower-priority
+  DECODE stream; the victim's cache state is captured — dense: a
+  bucketed :meth:`~apex_tpu.serving.engine.DecodeEngine.capture_slot`
+  snapshot; paged: block references, zero-copy — and later resumed
+  *bit-exactly*: same tokens, same f32 logits, because the restored
+  bytes ARE the cache bytes), arrival-relative **deadline shedding**
+  at every step boundary (admission-time and mid-queue), per-tenant
+  **weighted round-robin** admission with in-flight caps, and
+  :meth:`ContinuousBatchingScheduler.cancel` (available with or
+  without a policy) releasing slot/blocks/pins without disturbing
+  neighbors.  ``RequestResult.finish_reason`` distinguishes
+  ``eos`` / ``length`` / ``cancelled`` / ``shed`` /
+  ``preempted-resumed`` (finished normally after >= 1 lossless
+  preemption; :data:`SERVED_REASONS` names the reasons that delivered
+  full service).  Without a policy the scheduler is byte-for-byte the
+  FIFO scheduler — identical event stream, identical metric snapshot
+  (pinned by ``tests/test_serving_policy.py``).
+
 Determinism: sampling draws from explicit per-request PRNG keys
 (``fold_in(PRNGKey(seed), token_index)``) — the clock feeds telemetry
 only, never token choice, so a replay with the same seeds reproduces
-every stream bit-for-bit regardless of arrival timing.
+every stream bit-for-bit regardless of arrival timing.  Preemption
+preserves this: the sampler's key index is the token count, which
+suspend/resume never rewinds.
 """
 
 from __future__ import annotations
@@ -96,16 +118,31 @@ from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import DecodeEngine, request_key
 from apex_tpu.serving.paged_kv_cache import blocks_per_slot
+from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
+           "SchedulerStalled", "SERVED_REASONS",
            "ContinuousBatchingScheduler"]
 
 logger = get_logger("serving.scheduler")
 
+#: finish reasons that delivered the request's full token stream —
+#: goodput accounting counts ONLY these as completions (a cancelled or
+#: shed request "finished" in the bookkeeping sense but served nothing
+#: it promised)
+SERVED_REASONS = frozenset({"eos", "length", "preempted-resumed"})
+
 
 class QueueFull(RuntimeError):
     """The bounded request queue is at capacity — apply backpressure."""
+
+
+class SchedulerStalled(RuntimeError):
+    """``run()`` exceeded its progress bound with work still pending —
+    an engine or driver bug (a stream that never finishes, a hook that
+    re-queues forever), surfaced with the scheduler's state instead of
+    spinning silently."""
 
 
 class RequestPhase(enum.Enum):
@@ -121,6 +158,13 @@ class Request:
 
     ``temperature <= 0`` is greedy; ``top_k <= 0`` means no truncation.
     ``eos_id=None`` disables EOS eviction (run to ``max_new_tokens``).
+
+    The control-plane fields are inert without a
+    ``policy=``: ``priority`` (higher admits first and may preempt
+    strictly lower), ``deadline_s`` (completion deadline relative to
+    submission; expired queued requests are shed), and ``tenant``
+    (fairness bucket for weighted round-robin admission and in-flight
+    caps).  A FIFO scheduler ignores all three, byte-for-byte.
     """
 
     rid: str
@@ -130,6 +174,9 @@ class Request:
     temperature: float = 0.0
     top_k: int = 0
     seed: int = 0
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    tenant: str = "default"
 
 
 @dataclasses.dataclass
@@ -138,10 +185,14 @@ class RequestResult:
 
     rid: str
     tokens: List[int]
-    finish_reason: str                 # "eos" | "length"
-    ttft_s: float                      # submit -> first token
+    # "eos" | "length" | "cancelled" | "shed" | "preempted-resumed"
+    # (the last: finished normally after >= 1 lossless preemption —
+    # full service was delivered; see SERVED_REASONS)
+    finish_reason: str
+    ttft_s: float                      # submit -> first token (NaN if none)
     total_s: float                     # submit -> finished
     tokens_per_s: float
+    preemptions: int = 0               # lossless preempt/resume cycles
 
 
 @dataclasses.dataclass
@@ -163,10 +214,25 @@ class _Active:
     chain: str = PrefixCache.ROOT
     blocks_cached: int = 0
     pinned: List = dataclasses.field(default_factory=list)
+    preemptions: int = 0     # lossless suspend/resume cycles survived
 
     @property
     def prompt_remaining(self) -> int:
         return len(self.request.prompt) - self.prompt_pos
+
+
+@dataclasses.dataclass
+class _Suspended:
+    """A preempted DECODE stream awaiting resume: the frozen host
+    stream state plus the captured cache — a dense host K/V snapshot,
+    or held paged block references (the blocks themselves never moved;
+    the hold keeps them alive across the slot release)."""
+
+    st: _Active
+    length: int                               # cached rows at capture
+    kv: Optional[tuple] = None                # dense: (k, v) host arrays
+    block_ids: Optional[List[int]] = None     # paged: referenced blocks
+    t_suspended: float = 0.0
 
 
 class ContinuousBatchingScheduler:
@@ -182,6 +248,13 @@ class ContinuousBatchingScheduler:
     live streams.  Set it large to drain prompts greedily (admission
     stalls decode, the pre-budget behavior), small to bound the decode
     hiccup any single step can suffer.
+
+    ``policy=SchedulingPolicy(...)`` turns on the control plane —
+    priority admission with lossless preemption, deadline shedding,
+    weighted-round-robin tenant fairness (see
+    :mod:`apex_tpu.serving.policy`).  ``None`` (the default) is the
+    byte-for-byte FIFO scheduler: identical event stream, identical
+    metric snapshot, identical compiled-program set.
     """
 
     def __init__(self, engine: DecodeEngine, *, max_queue: int = 64,
@@ -189,6 +262,7 @@ class ContinuousBatchingScheduler:
                  prefill_budget: Optional[int] = None,
                  speculation: Optional[SpeculationConfig] = None,
                  prefix_caching: Optional[PrefixCacheConfig] = None,
+                 policy: Optional[SchedulingPolicy] = None,
                  clock: Callable[[], float] = time.monotonic):
         if prefill_budget is None:
             prefill_budget = engine.prefill_len
@@ -263,6 +337,22 @@ class ContinuousBatchingScheduler:
         self._results: Dict[str, RequestResult] = {}
         self._step_index = 0
         self._admit_seq = 0
+        # O(1) duplicate-rid guard: every rid currently queued, active,
+        # suspended, or holding an unclaimed result (pop_result removes
+        # it — the rid becomes reusable, exactly the old linear-scan
+        # semantics at set-lookup cost)
+        self._live_rids: set = set()
+        # control plane (None == byte-for-byte FIFO: no shedding, no
+        # preemption, no tenant gauge, no new events)
+        self.policy = policy
+        self._wrr = (WeightedRoundRobin(policy)
+                     if policy is not None else None)
+        self._suspended: List[_Suspended] = []
+        self._tenants_seen: set = set()
+        self._preempted_total = 0
+        self._resumed_total = 0
+        self._cancelled_total = 0
+        self._shed_total = 0
         # cumulative speculative-path accounting (host ints; the
         # speedup gauge and bench read these)
         self._spec_dispatches = 0
@@ -275,10 +365,11 @@ class ContinuousBatchingScheduler:
         """Enqueue; raises :class:`QueueFull` at ``max_queue`` and
         ``ValueError`` for requests the engine can never serve."""
         rid = request.rid
-        if (rid in self._results
-                or any(r.rid == rid for r, _ in self._queue)
-                or any(st.request.rid == rid
-                       for st in self._active.values())):
+        # O(1): the live-rid set mirrors queue + active + suspended +
+        # unclaimed results exactly (updated at submit / finish /
+        # pop_result) — the old three linear scans made every submit
+        # O(n) and a loadgen run O(n^2)
+        if rid in self._live_rids:
             raise ValueError(
                 f"duplicate rid {rid!r}: already "
                 f"{'finished' if rid in self._results else 'in flight'} "
@@ -291,6 +382,14 @@ class ContinuousBatchingScheduler:
                 f"(got {request.max_new_tokens})")
         if n < 1:
             raise ValueError(f"{request.rid}: empty prompt")
+        if request.deadline_s is not None and request.deadline_s <= 0:
+            raise ValueError(
+                f"{request.rid}: deadline_s must be > 0 (or None), got "
+                f"{request.deadline_s} — an already-expired deadline "
+                f"is a caller bug, not a sheddable request")
+        if not request.tenant:
+            raise ValueError(
+                f"{request.rid}: tenant must be a non-empty string")
         # prompts longer than prefill_len are fine (chunked cached
         # prefill serves them); the only hard ceiling is cache capacity.
         # The FINAL sampled token is never appended (the request finishes
@@ -319,6 +418,9 @@ class ContinuousBatchingScheduler:
         if len(self._queue) >= self.max_queue:
             raise QueueFull(f"queue at capacity ({self.max_queue})")
         self._queue.append((request, self._clock()))
+        self._live_rids.add(rid)
+        if self.policy is not None:
+            self._tenants_seen.add(request.tenant)
         emit_event("serving_request_queued", rid=request.rid,
                    prompt_tokens=n, queue_depth=len(self._queue))
 
@@ -357,21 +459,106 @@ class ContinuousBatchingScheduler:
                 "accepted": self._spec_accepted,
                 "emitted": self._spec_emitted}
 
+    @property
+    def queued_rids(self) -> List[str]:
+        """Rids waiting for admission, in arrival order."""
+        return [r.rid for r, _ in self._queue]
+
+    @property
+    def active_rids(self) -> List[str]:
+        """Rids holding a slot, in slot order."""
+        return [self._active[s].request.rid
+                for s in sorted(self._active)]
+
+    def progress_of(self, rid: str) -> int:
+        """Tokens emitted so far for ``rid`` — live count while active
+        or suspended, the result's count once terminal, 0 while queued
+        or unknown (lenient, like :meth:`phase_of`: fault drivers poll
+        rids that may not have been submitted yet)."""
+        result = self._results.get(rid)
+        if result is not None:
+            return len(result.tokens)
+        for st in self._active.values():
+            if st.request.rid == rid:
+                return len(st.tokens)
+        for sus in self._suspended:
+            if sus.st.request.rid == rid:
+                return len(sus.st.tokens)
+        return 0
+
     def phase_of(self, rid: str) -> RequestPhase:
         if rid in self._results:
             return RequestPhase.DONE
         for st in self._active.values():
             if st.request.rid == rid:
                 return st.phase
+        for sus in self._suspended:
+            if sus.st.request.rid == rid:
+                return sus.st.phase      # DECODE, parked for resume
         return RequestPhase.QUEUED
 
     # ---- the loop --------------------------------------------------------
+    def _paged_available(self) -> int:
+        """Blocks an admission may claim: free pool blocks minus what
+        already-admitted streams still RESERVE for their worst-case
+        growth (blocks allocate lazily — pricing the prompt alone would
+        let concurrent streams pass the gate and race each other into
+        an uncatchable ``BlockPoolExhausted`` mid-DECODE), plus what
+        prefix-cache eviction could reclaim."""
+        bs = self.engine.block_size
+        reserved = 0
+        for st in self._active.values():
+            rows = (len(st.request.prompt)
+                    + st.request.max_new_tokens - 1)
+            owned = self.engine.block_pool.owned_blocks(st.slot)
+            reserved += max(blocks_per_slot(rows, bs) - owned, 0)
+        return self.engine.free_blocks() - reserved + (
+            self._prefix.evictable_blocks()
+            if self._prefix is not None else 0)
+
+    def _admit_request(self, request: Request, t_submit: float,
+                       slot: int) -> None:
+        """Shared admission body (FIFO and policy paths): bind the
+        request to ``slot``, emit the admission event, and run the
+        prefix-cache match — byte-for-byte the pre-policy sequence."""
+        # per-request draft state: greedy requests under an enabled
+        # speculation config start at the widest draft (adapt_k
+        # narrows it on rejection); sampled-temperature requests get
+        # draft_k=0 — drafting is BYPASSED for them and their whole
+        # path (events, metrics, compiled programs) stays
+        # byte-for-byte the plain one
+        draft_k = (self.speculation.max_draft
+                   if self.speculation is not None
+                   and request.temperature <= 0 else 0)
+        st = _Active(request=request, slot=slot, seq=self._admit_seq,
+                     base_key=np.asarray(request_key(request.seed)),
+                     tokens=[], t_submit=t_submit, t_first=0.0,
+                     draft_k=draft_k)
+        self._admit_seq += 1
+        self._active[slot] = st
+        logger.debug("admitted %s into slot %d (queue %d deep)",
+                     request.rid, slot, len(self._queue))
+        # queue_wait_s rides the event so the obs bridge can feed
+        # the apex_serving_queue_wait_seconds histogram and the
+        # request-trace recorder can cross-check its own stamps —
+        # measured on this scheduler's (injectable) clock
+        emit_event("serving_request_admitted", rid=request.rid,
+                   slot=slot, prompt_tokens=len(request.prompt),
+                   queue_depth=len(self._queue),
+                   queue_wait_s=round(self._clock() - t_submit, 6))
+        if self._prefix is not None:
+            self._match_and_restore(st)
+
     def _admit(self) -> None:
         """Fill free slots from the queue (FIFO).  Admission assigns a
         slot only — the prompt is cached chunk-by-chunk by
         :meth:`_prefill_work` under the per-step budget, so admitting a
         long prompt never blocks this step's decode for its whole
-        length."""
+        length.  With a policy, selection (priority / fairness /
+        preemption) is delegated to :meth:`_admit_policy`."""
+        if self.policy is not None:
+            self._admit_policy()
+            return
         while self._queue:
             # the engine's slot-occupancy mirror is the ONE source of
             # truth for free slots (a scheduler-side copy could desync
@@ -389,58 +576,340 @@ class ContinuousBatchingScheduler:
                 # (live streams keep decoding and freeing; an idle
                 # system always admits so a too-tight pool fails loudly
                 # at allocation instead of deadlocking the queue).
-                # Blocks allocate lazily, so already-admitted streams
-                # RESERVE what they have yet to allocate; pricing the
-                # prompt alone would let concurrent streams pass this
-                # gate and then race each other into BlockPoolExhausted
-                # mid-DECODE — an uncatchable crash that loses every
-                # in-flight stream, not backpressure
                 request, _ = self._queue[0]
                 bs = self.engine.block_size
                 need = blocks_per_slot(
                     len(request.prompt) + request.max_new_tokens - 1,
                     bs)
-                reserved = 0
-                for st in self._active.values():
-                    rows = (len(st.request.prompt)
-                            + st.request.max_new_tokens - 1)
-                    owned = self.engine.block_pool.owned_blocks(
-                        st.slot)
-                    reserved += max(blocks_per_slot(rows, bs) - owned, 0)
-                avail = self.engine.free_blocks() - reserved + (
-                    self._prefix.evictable_blocks()
-                    if self._prefix is not None else 0)
-                if need > avail:
+                if need > self._paged_available():
                     break
             request, t_submit = self._queue.popleft()
+            self._admit_request(request, t_submit, free[0])
+
+    # ---- the control plane (opt-in; every method below is only ever
+    # reached when ``policy`` is set, except cancel() which is a plain
+    # API and emits only when actually called) -------------------------
+    def _tenant_inflight(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for st in self._active.values():
+            t = st.request.tenant
+            counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def _pick_victim(self, priority: int) -> Optional[_Active]:
+        """The stream a ``priority``-class admission may evict: the
+        lowest-priority DECODE stream strictly below ``priority``
+        (equal classes never preempt each other — no thrash), youngest
+        admission among equals (the least-established stream moves).
+        Mid-PREFILL streams are never preempted: their partial prompt
+        is cheaper to keep than to capture."""
+        victims = [st for st in self._active.values()
+                   if st.phase is RequestPhase.DECODE
+                   and st.request.priority < priority]
+        if not victims:
+            return None
+        return min(victims, key=lambda st: (st.request.priority,
+                                            -st.seq))
+
+    def _preempt(self, st: _Active, *, by_priority: int) -> None:
+        """Losslessly evict an active DECODE stream: capture its cache
+        state (dense: a bucketed host snapshot via
+        :meth:`~apex_tpu.serving.engine.DecodeEngine.capture_slot`;
+        paged: reference the slot's blocks — zero bytes move), release
+        the slot, and park the stream for a bit-exact resume."""
+        slot = st.slot
+        length = int(self.engine.lengths()[slot])
+        sus = _Suspended(st=st, length=length,
+                         t_suspended=self._clock())
+        if self._paged:
+            # hold one reference per block across the release: the
+            # slot's own references drop, ours keep the bytes resident
+            ids = self.engine.slot_block_ids(slot)[
+                :blocks_per_slot(length, self.engine.block_size)]
+            self.engine.block_pool.ref(ids)
+            sus.block_ids = ids
+        else:
+            k, v, _ = self.engine.capture_slot(slot)
+            sus.kv = (k, v)
+        self._active.pop(slot)
+        self.engine.release(slot)
+        st.slot = -1
+        st.preemptions += 1
+        self._suspended.append(sus)
+        self._preempted_total += 1
+        emit_event("serving_request_preempted", rid=st.request.rid,
+                   slot=slot, priority=st.request.priority,
+                   by_priority=by_priority,
+                   new_tokens=len(st.tokens), cached_tokens=length)
+
+    def _resume(self, sus: _Suspended, slot: int) -> None:
+        """Restore a suspended stream into a free slot bit-exactly:
+        the dense path writes the captured bytes back
+        (:meth:`~apex_tpu.serving.engine.DecodeEngine.restore_prefix`
+        — the existing restore program family, no new compiles), the
+        paged path aliases the held blocks (zero-copy) and drops the
+        suspension hold so the slot's writes need no spurious CoW."""
+        st = sus.st
+        if self._paged:
+            self.engine.alias_prefix(slot, sus.block_ids, sus.length)
+            # alias added the slot's references — drop the suspension
+            # hold, or every tail append would copy-on-write against a
+            # phantom sharer forever
+            self.engine.block_pool.deref(sus.block_ids)
+        else:
+            self.engine.restore_prefix(slot, sus.kv, sus.length)
+        st.slot = slot
+        self._active[slot] = st
+        self._resumed_total += 1
+        emit_event("serving_request_resumed", rid=st.request.rid,
+                   slot=slot, cached_tokens=sus.length,
+                   suspended_s=round(self._clock() - sus.t_suspended,
+                                     6))
+
+    def _admit_policy(self) -> None:
+        """Policy admission: serve the highest priority class with an
+        admissible request; within a class resume preempted streams
+        first (oldest preemption first), then draw tenants by smooth
+        weighted round-robin (FIFO within a tenant).  When no slot is
+        free, the class may preempt a strictly lower-priority DECODE
+        stream (``policy.preemption``); tenants at their in-flight cap
+        are skipped entirely."""
+        policy = self.policy
+        cap = policy.max_inflight_per_tenant
+        while self._queue or self._suspended:
+            inflight = self._tenant_inflight()
+
+            def ok(tenant: str) -> bool:
+                return cap is None or inflight.get(tenant, 0) < cap
+
+            res = [(i, s) for i, s in enumerate(self._suspended)
+                   if ok(s.st.request.tenant)]
+            qs = [(i, rt) for i, rt in enumerate(self._queue)
+                  if ok(rt[0].tenant)]
+            if not res and not qs:
+                break
+            best = max([s.st.request.priority for _, s in res]
+                       + [r.priority for _, (r, _) in qs])
+            # choose the candidate FIRST (resume before queued within
+            # the class; WRR across queued tenants), then check paged
+            # block feasibility, and only THEN preempt for it — a
+            # victim must never be evicted for an admission the pool
+            # cannot cover (the victim's suspension hold would keep
+            # its own blocks unavailable, and on a tight pool nothing
+            # ever frees: a livelock the run() bound turns into
+            # SchedulerStalled at best)
+            res_best = [(i, s) for i, s in res
+                        if s.st.request.priority == best]
+            snap = None
+            if res_best:
+                qi, sus = res_best[0]      # oldest preemption first
+                request = sus.st.request
+                # the resume itself allocates nothing (alias), but the
+                # stream's REMAINING growth must be coverable — its
+                # original reservation evaporated while it was off the
+                # active set
+                held = len(sus.block_ids) if sus.block_ids else 0
+            else:
+                qs_best = [(i, rt) for i, rt in qs
+                           if rt[0].priority == best]
+                tenants = {rt[0].tenant for _, rt in qs_best}
+                snap = self._wrr.snapshot()
+                tenant = self._wrr.pick(tenants)
+                qi, (request, t_submit) = next(
+                    (i, rt) for i, rt in qs_best
+                    if rt[0].tenant == tenant)
+                held = 0
+            if self._paged and self._active:
+                need = blocks_per_slot(
+                    len(request.prompt) + request.max_new_tokens - 1,
+                    self.engine.block_size) - held
+                if need > self._paged_available():
+                    if snap is not None:
+                        # roll the WRR charge back: the tenant was
+                        # picked but never served — leaving the charge
+                        # would skew fairness under pool pressure
+                        self._wrr.restore(snap)
+                    break
+            free = [s for s in self.engine.free_slots()
+                    if s not in self._active]
+            if not free:
+                victim = (self._pick_victim(best)
+                          if policy.preemption else None)
+                if victim is None:
+                    if snap is not None:
+                        self._wrr.restore(snap)
+                    break
+                self._preempt(victim, by_priority=best)
+                free = [s for s in self.engine.free_slots()
+                        if s not in self._active]
+                if not free:            # defensive; release frees it
+                    if snap is not None:
+                        self._wrr.restore(snap)
+                    break
             slot = free[0]
-            # per-request draft state: greedy requests under an enabled
-            # speculation config start at the widest draft (adapt_k
-            # narrows it on rejection); sampled-temperature requests get
-            # draft_k=0 — drafting is BYPASSED for them and their whole
-            # path (events, metrics, compiled programs) stays
-            # byte-for-byte the plain one
-            draft_k = (self.speculation.max_draft
-                       if self.speculation is not None
-                       and request.temperature <= 0 else 0)
-            st = _Active(request=request, slot=slot, seq=self._admit_seq,
-                         base_key=np.asarray(request_key(request.seed)),
-                         tokens=[], t_submit=t_submit, t_first=0.0,
-                         draft_k=draft_k)
-            self._admit_seq += 1
-            self._active[slot] = st
-            logger.debug("admitted %s into slot %d (queue %d deep)",
-                         request.rid, slot, len(self._queue))
-            # queue_wait_s rides the event so the obs bridge can feed
-            # the apex_serving_queue_wait_seconds histogram and the
-            # request-trace recorder can cross-check its own stamps —
-            # measured on this scheduler's (injectable) clock
-            emit_event("serving_request_admitted", rid=request.rid,
-                       slot=slot, prompt_tokens=len(request.prompt),
-                       queue_depth=len(self._queue),
-                       queue_wait_s=round(self._clock() - t_submit, 6))
-            if self._prefix is not None:
-                self._match_and_restore(st)
+            if res_best:
+                self._suspended.pop(qi)
+                self._resume(sus, slot)
+            else:
+                del self._queue[qi]
+                self._admit_request(request, t_submit, slot)
+
+    def _shed_expired(self) -> List[str]:
+        """Arrival-relative deadline shedding at the step boundary —
+        both admission-time and mid-queue: any request (queued, or
+        suspended by a preemption) whose completion deadline has
+        already passed can no longer meet it, so it is shed before it
+        wastes prefill budget.  Charged to goodput exactly like a
+        QueueFull rejection (``finish_reason="shed"`` is not a
+        :data:`SERVED_REASONS` member)."""
+        now = self._clock()
+        shed: List[str] = []
+        if self._queue and any(
+                r.deadline_s is not None for r, _ in self._queue):
+            keep: deque = deque()
+            for request, t_submit in self._queue:
+                if (request.deadline_s is not None
+                        and now - t_submit >= request.deadline_s):
+                    self._terminal_result(
+                        request, t_submit, t_first=0.0, tokens=[],
+                        reason="shed")
+                    self._shed_total += 1
+                    shed.append(request.rid)
+                    emit_event("serving_request_shed", rid=request.rid,
+                               deadline_s=request.deadline_s,
+                               waited_s=round(now - t_submit, 6),
+                               new_tokens=0,
+                               queue_depth=len(self._queue))
+                else:
+                    keep.append((request, t_submit))
+            self._queue = keep
+        if self._suspended:
+            keep_s: List[_Suspended] = []
+            for sus in self._suspended:
+                st = sus.st
+                deadline = st.request.deadline_s
+                if (deadline is not None
+                        and now - st.t_submit >= deadline):
+                    self._drop_suspended_state(sus)
+                    self._terminal_result(
+                        st.request, st.t_submit, t_first=st.t_first,
+                        tokens=st.tokens, reason="shed",
+                        preemptions=st.preemptions)
+                    self._shed_total += 1
+                    shed.append(st.request.rid)
+                    emit_event("serving_request_shed",
+                               rid=st.request.rid, deadline_s=deadline,
+                               waited_s=round(now - st.t_submit, 6),
+                               new_tokens=len(st.tokens),
+                               queue_depth=len(self._queue))
+                else:
+                    keep_s.append(sus)
+            self._suspended = keep_s
+        return shed
+
+    def _drop_suspended_state(self, sus: _Suspended) -> None:
+        """Release a suspended stream's captured state without
+        resuming it (shed past its deadline, or cancelled): the paged
+        hold is dereferenced (blocks free unless shared), the dense
+        host snapshot simply drops."""
+        if sus.block_ids is not None:
+            self.engine.block_pool.deref(sus.block_ids)
+            sus.block_ids = None
+        sus.kv = None
+
+    def _terminal_result(self, request: Request, t_submit: float, *,
+                         t_first: float, tokens: List[int], reason: str,
+                         preemptions: int = 0) -> None:
+        """Record a non-served terminal outcome (cancelled / shed):
+        partial tokens are kept (they were really produced), ``ttft_s``
+        is NaN when no first token ever emitted.  First-token existence
+        is judged by the token count, never by ``t_first`` truthiness —
+        a virtual clock starting at 0.0 stamps a legitimate first token
+        as exactly 0.0."""
+        now = self._clock()
+        total = max(now - t_submit, 1e-9)
+        self._results[request.rid] = RequestResult(
+            rid=request.rid, tokens=list(tokens), finish_reason=reason,
+            ttft_s=(t_first - t_submit) if tokens else float("nan"),
+            total_s=total, tokens_per_s=len(tokens) / total,
+            preemptions=preemptions)
+
+    def cancel(self, rid: str) -> bool:
+        """Cancel one request wherever it lives — queued, suspended,
+        or active — releasing its slot, paged blocks, and prefix-cache
+        pins without disturbing any neighboring stream.  Partial
+        output is kept in the result (``finish_reason="cancelled"``).
+        Returns ``True`` when cancelled, ``False`` when the request
+        already finished (too late — the result stands); raises
+        ``KeyError`` for a rid this scheduler does not know.  Works
+        with or without a policy (cancellation is backpressure from
+        the *caller* — a disconnected client — not a scheduling
+        decision)."""
+        for i, (request, t_submit) in enumerate(self._queue):
+            if request.rid == rid:
+                del self._queue[i]
+                self._terminal_result(request, t_submit, t_first=0.0,
+                                      tokens=[], reason="cancelled")
+                self._cancelled_total += 1
+                emit_event("serving_request_cancelled", rid=rid,
+                           phase="queued", new_tokens=0)
+                return True
+        for i, sus in enumerate(self._suspended):
+            if sus.st.request.rid == rid:
+                self._suspended.pop(i)
+                self._drop_suspended_state(sus)
+                st = sus.st
+                self._terminal_result(st.request, st.t_submit,
+                                      t_first=st.t_first,
+                                      tokens=st.tokens,
+                                      reason="cancelled",
+                                      preemptions=st.preemptions)
+                self._cancelled_total += 1
+                emit_event("serving_request_cancelled", rid=rid,
+                           phase="suspended",
+                           new_tokens=len(st.tokens))
+                return True
+        for slot, st in list(self._active.items()):
+            if st.request.rid == rid:
+                if self._prefix is not None:
+                    # a mid-PREFILL cancellation still pins the chain
+                    # it was matching/extending — release, or the pins
+                    # leak and those entries can never be evicted
+                    self._release_pins(st)
+                st.phase = RequestPhase.DONE
+                self._active.pop(slot)
+                self.engine.release(slot)
+                self._terminal_result(st.request, st.t_submit,
+                                      t_first=st.t_first,
+                                      tokens=st.tokens,
+                                      reason="cancelled",
+                                      preemptions=st.preemptions)
+                self._cancelled_total += 1
+                emit_event("serving_request_cancelled", rid=rid,
+                           phase=("decode" if st.tokens else "prefill"),
+                           new_tokens=len(st.tokens))
+                return True
+        if rid in self._results:
+            return False
+        raise KeyError(
+            f"cancel({rid!r}): unknown rid — never submitted, or its "
+            f"result was already claimed via pop_result")
+
+    @property
+    def suspended_count(self) -> int:
+        """Preempted streams parked for a bit-exact resume."""
+        return len(self._suspended)
+
+    @property
+    def control_stats(self) -> Dict[str, int]:
+        """Cumulative control-plane accounting: ``preempted`` /
+        ``resumed`` lossless preemption cycles, ``cancelled`` requests,
+        ``shed`` deadline evictions.  All zero without a policy (and
+        with no :meth:`cancel` calls) — the identity witness."""
+        return {"preempted": self._preempted_total,
+                "resumed": self._resumed_total,
+                "cancelled": self._cancelled_total,
+                "shed": self._shed_total}
 
     # ---- prefix caching (opt-in; every call below is guarded by
     # ``self._prefix is not None``, so the default path never changes) --
@@ -458,11 +927,12 @@ class ContinuousBatchingScheduler:
         abandoned paged cache otherwise pins its blocks forever and
         the allocator keeps reclaiming into the dead store.  Refuses
         while work is in flight; idempotent once drained."""
-        if self._active or self._queue:
+        if self._active or self._queue or self._suspended:
             raise RuntimeError(
-                f"close() with {len(self._active)} active stream(s) and "
-                f"{len(self._queue)} queued request(s) — drain with "
-                f"run() first")
+                f"close() with {len(self._active)} active stream(s), "
+                f"{len(self._queue)} queued request(s) and "
+                f"{len(self._suspended)} suspended stream(s) — drain "
+                f"with run() (or cancel()) first")
         if self._prefix is not None:
             self._prefix.clear()
             if (self._paged and self.engine.block_pool.reclaim
@@ -611,9 +1081,15 @@ class ContinuousBatchingScheduler:
         requests, instant EOS)."""
         finished: List[str] = []
         budget = self.prefill_budget
+        # FIFO by admission order; under a policy, priority classes
+        # drain first (a high-priority admission's first token must
+        # not wait behind an earlier low-priority long prompt)
+        key = (
+            (lambda s: s.seq) if self.policy is None
+            else (lambda s: (-s.request.priority, s.seq)))
         for st in sorted((s for s in self._active.values()
                           if s.phase is RequestPhase.PREFILL),
-                         key=lambda s: s.seq):
+                         key=key):
             while budget > 0 and st.prompt_remaining:
                 chunk = min(st.prompt_remaining,
                             self.engine.prefill_len, budget)
@@ -659,11 +1135,19 @@ class ContinuousBatchingScheduler:
             return False
         now = self._clock()
         total = max(now - st.t_submit, 1e-9)
+        # a stream that survived >= 1 lossless preemption finished with
+        # full service (same tokens it would have produced uninterrupted
+        # — bit-exact resume) but reports it visibly: latency fields of
+        # a "preempted-resumed" result include the suspension gaps
+        reason = "eos" if done_eos else "length"
+        if st.preemptions:
+            reason = "preempted-resumed"
         result = RequestResult(
             rid=request.rid, tokens=list(st.tokens),
-            finish_reason="eos" if done_eos else "length",
+            finish_reason=reason,
             ttft_s=st.t_first - st.t_submit, total_s=total,
-            tokens_per_s=len(st.tokens) / total)
+            tokens_per_s=len(st.tokens) / total,
+            preemptions=st.preemptions)
         st.phase = RequestPhase.DONE
         self._results[request.rid] = result
         self._active.pop(st.slot, None)
@@ -758,11 +1242,16 @@ class ContinuousBatchingScheduler:
                 + sum(len(r.prompt) for r, _ in self._queue))
 
     def step(self) -> List[str]:
-        """One step boundary: admit into free slots, spend the prefill
-        budget on prompt chunks, then one shared decode step for every
-        decoding slot.  Returns rids finished at this boundary."""
+        """One step boundary: (with a policy) shed expired deadlines,
+        then admit into free slots — possibly preempting — spend the
+        prefill budget on prompt chunks, then one shared decode step
+        for every decoding slot.  Returns rids that reached a terminal
+        state at this boundary (finished or shed)."""
+        finished: List[str] = []
+        if self.policy is not None and self.policy.deadline_shedding:
+            finished.extend(self._shed_expired())
         self._admit()
-        finished = self._prefill_work()
+        finished.extend(self._prefill_work())
         decoding = {slot: st for slot, st in self._active.items()
                     if st.phase is RequestPhase.DECODE}
         if decoding and self.speculation is not None:
@@ -826,6 +1315,15 @@ class ContinuousBatchingScheduler:
             # stream stays byte-for-byte untouched
             obs_bridge.SERVING_BLOCK_POOL_UTILIZATION.set(
                 self.engine.block_pool_utilization())
+        if self.policy is not None:
+            # per-tenant in-flight gauge, every tenant this scheduler
+            # ever saw (a tenant dropping to 0 must READ 0, not hold
+            # its last value) — only under a policy, so the default
+            # metric stream stays byte-for-byte untouched
+            counts = self._tenant_inflight()
+            for tenant in self._tenants_seen:
+                obs_bridge.SERVING_TENANT_INFLIGHT.set(
+                    counts.get(tenant, 0), tenant=tenant)
         # every step like the others (a cheap host-side jit-cache read):
         # a scrape during the first log_interval steps must not read 0
         # for a gauge documented as "1 == shape-stable"
@@ -847,14 +1345,50 @@ class ContinuousBatchingScheduler:
                        prefill_backlog=backlog)
         return finished
 
+    def _derived_step_bound(self) -> int:
+        """A generous progress bound for :meth:`run`: every step of a
+        healthy drain either caches >= 1 prompt token (budget >= 1),
+        emits >= 1 token for >= 1 decoding stream, or retires a
+        request — so total steps are bounded by the remaining token
+        work.  4x slack plus a constant covers admission/resume
+        boundaries; only a stream that genuinely never finishes (an
+        engine bug) can exceed it."""
+        work = 0
+        for request, _ in self._queue:
+            work += len(request.prompt) + request.max_new_tokens
+        for st in self._active.values():
+            work += st.prompt_remaining + max(
+                st.request.max_new_tokens - len(st.tokens), 1)
+        for sus in self._suspended:
+            work += max(sus.st.request.max_new_tokens
+                        - len(sus.st.tokens), 1)
+        return 64 + 4 * work
+
     def run(self, max_steps: Optional[int] = None
             ) -> Dict[str, RequestResult]:
-        """Drive :meth:`step` until queue and slots drain (or
-        ``max_steps``); returns rid -> :class:`RequestResult`."""
+        """Drive :meth:`step` until queue, slots, and suspended
+        streams drain; returns rid -> :class:`RequestResult`.
+
+        ``max_steps`` is a progress bound, not a pacing knob (drive
+        :meth:`step` directly for partial drains): left ``None`` it is
+        derived from the queued work, and exceeding it raises
+        :class:`SchedulerStalled` with the scheduler's state — an
+        engine bug that never finishes a stream surfaces as a
+        diagnosable error instead of spinning forever."""
+        if max_steps is None:
+            max_steps = self._derived_step_bound()
         steps = 0
-        while self._queue or self._active:
-            if max_steps is not None and steps >= max_steps:
-                break
+        while self._queue or self._active or self._suspended:
+            if steps >= max_steps:
+                raise SchedulerStalled(
+                    f"no drain after {steps} steps (bound {max_steps}):"
+                    f" {len(self._queue)} queued, "
+                    f"{len(self._active)} active "
+                    f"({[st.request.rid for st in self._active.values()][:8]}),"
+                    f" {len(self._suspended)} suspended, prefill "
+                    f"backlog {self.prefill_backlog} tokens — an "
+                    f"engine or driver bug is keeping a stream from "
+                    f"finishing")
             self.step()
             steps += 1
         return dict(self._results)
@@ -868,9 +1402,12 @@ class ContinuousBatchingScheduler:
         should pop results as :meth:`step` reports them finished —
         unclaimed results are retained indefinitely (and their rids stay
         reserved by the duplicate guard)."""
-        return self._results.pop(rid)
+        result = self._results.pop(rid)
+        self._live_rids.discard(rid)
+        return result
 
     def pop_results(self) -> Dict[str, RequestResult]:
         """Claim (and forget) every finished result."""
         out, self._results = self._results, {}
+        self._live_rids.difference_update(out)
         return out

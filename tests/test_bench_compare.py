@@ -70,6 +70,34 @@ class TestClassify:
         assert bc.classify("attempts") is None
         assert bc.classify("prefill_buckets[0]") is None
 
+    def test_control_plane_fields_direction_aware(self):
+        """The ISSUE-13 serving_slo.policy block: the deltas grade
+        (speedup higher, goodput_delta higher, the per-variant p99
+        lower), the activity counts (how often the policy preempted /
+        shed) are workload shape — informational, never graded."""
+        base = "serving_slo.policy"
+        assert bc.classify(f"{base}.hp_ttft_p99_speedup") == "higher"
+        assert bc.classify(f"{base}.goodput_delta") == "higher"
+        assert bc.classify(f"{base}.fifo.hp_ttft_p99_s") == "lower"
+        assert bc.classify(f"{base}.policy.hp_ttft_p99_s") == "lower"
+        assert bc.classify(f"{base}.policy.goodput") == "higher"
+        for count in ("preempted", "resumed", "shed", "hp_served",
+                      "completed"):
+            assert bc.classify(f"{base}.policy.{count}") is None, count
+
+    def test_policy_regression_and_improvement_graded(self):
+        old = {"serving_slo": {"policy": {"hp_ttft_p99_speedup": 5.0,
+                                          "goodput_delta": 0.1,
+                                          "policy": {"preempted": 2}}}}
+        worse = {"serving_slo": {"policy": {"hp_ttft_p99_speedup": 1.0,
+                                            "goodput_delta": 0.1,
+                                            "policy": {"preempted": 9}}}}
+        kinds = _kinds(bc.compare(old, worse))
+        assert kinds["serving_slo.policy.hp_ttft_p99_speedup"] == \
+            "regression"
+        # the activity count changed but is informational
+        assert kinds.get("serving_slo.policy.policy.preempted") == "info"
+
 
 class TestFlatten:
     def test_nested_paths_and_lists(self):

@@ -481,6 +481,22 @@ def test_serving_slo_metrics_block():
     # bounded by the bucket table
     assert r["decode_compiles"] == 1
     assert 1 <= r["prefill_compiles"] <= len(r["prefill_buckets"])
+    # the ISSUE-13 control-plane variant: FIFO vs policy on one
+    # SLO-differentiated workload.  At this toy size the run is not
+    # reliably overloaded, so the assertions are structural (the
+    # direction story lives in the default-size PERF_NOTES round);
+    # the compile identity IS asserted inside the block itself
+    pol = r["policy"]
+    hi_count = len([i for i in range(10) if i % 3 == 0])
+    for variant in ("fifo", "policy"):
+        v = pol[variant]
+        assert 0.0 <= v["goodput"] <= 1.0, variant
+        assert v["hp_ttft_p99_s"] >= 0.0
+        assert v["hp_served"] == hi_count
+        assert v["completed"] <= 10
+    assert pol["fifo"]["preempted"] == pol["fifo"]["shed"] == 0
+    assert pol["hp_ttft_p99_speedup"] > 0.0
+    assert -1.0 <= pol["goodput_delta"] <= 1.0
 
 
 def test_serving_slo_block_reproducible_schedule():

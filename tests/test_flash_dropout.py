@@ -101,9 +101,17 @@ def test_kept_activation_statistics(qkv):
     np.testing.assert_allclose(base, 1.0, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_expectation_matches_no_dropout(qkv):
     """E_seed[dropout output] -> no-dropout output (unbiasedness of the
-    1/(1-r) rescaling), for values and gradients."""
+    1/(1-r) rescaling), for values and gradients.
+
+    Marked slow (~48 s: 24 seeded forwards + 24 seeded grads): this is
+    a *statistical quality bar* over a seed ensemble, not a
+    correctness witness — the deterministic per-seed forward/backward
+    tests and the exact no-dropout parity above stay tier-1, same
+    trade the sparsity permutation quality bar made for the paged
+    tests (tier-1 runs against a hard wall-clock deadline)."""
     q, k, v = qkv
     base = np.asarray(flash_attention(q, k, v, causal=True,
                                       block_q=BLOCK, block_k=BLOCK))

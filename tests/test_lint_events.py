@@ -1,0 +1,99 @@
+"""Event-coverage lint (ISSUE 13 satellite): every ``emit_event`` kind
+under ``apex_tpu/`` is either bridged to a metric handler or explicitly
+allowlisted as countable-only — a typo'd kind can no longer drop its
+measurements silently (the bridge ignores unknown kinds by design).
+
+The repo-level check runs the real tree; the unit tests pin the lint's
+own behavior on synthetic sources (unknown kind, non-literal kind, dead
+handler, stale allowlist both ways).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+
+from check_events import (  # noqa: E402
+    ALLOWLIST,
+    check,
+    collect_emits_from_source,
+    collect_handlers,
+    find_violations,
+)
+
+
+def test_repo_events_are_clean():
+    assert find_violations() == []
+
+
+def test_cli_exit_code_clean():
+    tool = Path(__file__).resolve().parent.parent / "tools" / \
+        "check_events.py"
+    proc = subprocess.run([sys.executable, str(tool)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "events lint clean" in proc.stdout
+
+
+def _emits(src):
+    return collect_emits_from_source(src, "fake.py")
+
+
+def test_unknown_kind_flagged():
+    emits = _emits('emit_event("totally_new_kind", x=1)\n')
+    problems = check(emits, handlers=[], allowlist=frozenset())
+    assert len(problems) == 1
+    assert "totally_new_kind" in problems[0]
+    assert "silently drop" in problems[0]
+
+
+def test_handled_and_allowlisted_kinds_pass():
+    emits = _emits('emit_event("a", x=1)\nemit_event("b")\n')
+    assert check(emits, handlers=["a"], allowlist=frozenset({"b"})) == []
+
+
+def test_non_literal_kind_flagged():
+    emits = _emits('kind = "x"\nemit_event(kind, x=1)\n')
+    problems = check(emits, handlers=[], allowlist=frozenset())
+    assert len(problems) == 1
+    assert "string literals" in problems[0]
+
+
+def test_dead_handler_flagged():
+    problems = check(_emits('emit_event("a")\n'),
+                     handlers=["a", "ghost"], allowlist=frozenset())
+    assert len(problems) == 1
+    assert "ghost" in problems[0] and "dead handler" in problems[0]
+
+
+def test_stale_allowlist_flagged_both_ways():
+    # entry that is also handled
+    problems = check(_emits('emit_event("a")\n'), handlers=["a"],
+                     allowlist=frozenset({"a"}))
+    assert len(problems) == 1 and "also handled" in problems[0]
+    # entry nothing emits
+    problems = check(_emits('emit_event("a")\n'), handlers=["a"],
+                     allowlist=frozenset({"never_emitted"}))
+    assert len(problems) == 1 and "emitted nowhere" in problems[0]
+
+
+def test_multiline_and_attribute_calls_collected():
+    src = ('from apex_tpu._logging import emit_event\n'
+           'import apex_tpu._logging as lg\n'
+           'emit_event(\n    "wrapped_kind",\n    a=1)\n'
+           'lg.emit_event("attr_kind")\n')
+    kinds = {e.kind for e in _emits(src)}
+    assert kinds == {"wrapped_kind", "attr_kind"}
+
+
+def test_bridge_handlers_parse_and_cover_serving_control_plane():
+    bridge = Path(__file__).resolve().parent.parent / "apex_tpu" / \
+        "obs" / "bridge.py"
+    handlers = set(collect_handlers(bridge.read_text()))
+    # the control-plane counters this PR added must stay bridged (and
+    # therefore OUT of the allowlist)
+    for kind in ("serving_request_preempted", "serving_request_cancelled",
+                 "serving_request_shed"):
+        assert kind in handlers
+        assert kind not in ALLOWLIST

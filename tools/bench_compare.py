@@ -66,7 +66,13 @@ _INFORMATIONAL = (
     "seed", "fingerprint", "loss0", "loss_end", "params_m",
 )
 _INFORMATIONAL_EXACT = ("n", "burst", "steps", "period_s",
-                        "deadline_s", "shed", "offered", "completed")
+                        "deadline_s", "shed", "offered", "completed",
+                        # control-plane activity counts: how often the
+                        # policy preempted/resumed/cancelled is workload
+                        # shape, not a graded rate (the graded outcomes
+                        # are hp_ttft_p99_s / goodput / the deltas)
+                        "preempted", "resumed", "cancelled",
+                        "hp_served")
 
 
 class Leaf(NamedTuple):

@@ -93,7 +93,8 @@ PAGES = {
         "apex_tpu.serving.paged_kv_cache",
         "apex_tpu.serving.engine", "apex_tpu.serving.draft",
         "apex_tpu.serving.prefix_cache",
-        "apex_tpu.serving.scheduler", "apex_tpu.serving.loadgen",
+        "apex_tpu.serving.scheduler", "apex_tpu.serving.policy",
+        "apex_tpu.serving.loadgen",
         "apex_tpu.serving.weights",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
@@ -788,6 +789,81 @@ overlap, asserted against the harness's own measured noise
 floor; streams token-identical; restore compiles bounded by
 the prefill bucket table).
 
+## The serving control plane (`serving.policy`)
+
+`ContinuousBatchingScheduler(..., policy=SchedulingPolicy(...))` turns
+arrival-order FIFO into policy.  Everything below is host-side
+*selection* at step boundaries; the compiled-program set never grows
+(preempt/resume rides the existing region-read / restore / alias
+program families, asserted via `utils.compat.compile_count`), and a
+scheduler **without** `policy=` is byte-for-byte the FIFO scheduler —
+identical event stream, identical metric snapshot (tier-1 pins the
+identity with policy-annotated requests through a policy-less
+scheduler).
+
+- **Priority classes** (`Request.priority`, higher wins): admission
+  always serves the highest class with an admissible request; within a
+  class, previously preempted streams resume first, then tenants by
+  weighted round-robin, then FIFO.  Priority also orders the per-step
+  prefill budget, so a high-priority first token never waits behind an
+  earlier low-priority long prompt.
+- **Lossless preemption** (`preemption=True`): when no slot is free, a
+  queued request may evict a *strictly* lower-priority DECODE stream
+  (equal classes never preempt each other — no thrash; mid-PREFILL
+  streams are never victims).  The eviction is **lossless**, which
+  almost no serving stack can claim, and the argument is mechanical:
+  the victim's cache rows `[0, len)` are snapshotted verbatim (dense:
+  `DecodeEngine.capture_slot`, bucket-decomposed region reads; paged:
+  the slot's block ids gain a pool reference — zero bytes move), its
+  host stream state (tokens, PRNG base key, draft length) is frozen,
+  and resume writes the *same bytes* back
+  (`restore_prefix` / `alias_prefix`).  Attention over identical cache
+  bytes at identical reduction extents produces identical f32 logits,
+  and the sampler keys by `(seed, token_index)` — which suspension
+  never rewinds — so the resumed stream emits exactly the tokens the
+  uninterrupted stream would have (tier-1 pins exact logits across the
+  boundary).  A finished-after-preemption result reports
+  `finish_reason="preempted-resumed"` and its cycle count.
+- **Cancellation** (`scheduler.cancel(rid)`, works with or without a
+  policy): removes a request wherever it lives — queued, active, or
+  suspended — releasing its slot, paged blocks, and prefix-cache pins
+  without disturbing neighbors (tier-1 pins neighbor bit-identity and
+  the pin-release).  Partial output is kept
+  (`finish_reason="cancelled"`); cancelling a finished request returns
+  `False`, an unknown rid raises `KeyError`.
+- **Deadline shedding** (`Request.deadline_s`, relative to
+  submission; `deadline_shedding=True`): at every step boundary — so
+  both at admission time and mid-queue — a queued (or suspended)
+  request whose completion deadline has already passed is shed before
+  it wastes prefill budget (`finish_reason="shed"`, zero/partial
+  tokens).  Goodput accounting charges sheds and cancellations as
+  misses everywhere (`SERVED_REASONS` in the loadgen,
+  `build_report` in obs): finishing early by giving up is not
+  goodput.
+- **Tenant fairness** (`Request.tenant`): within a priority class,
+  queued requests are drawn by smooth weighted round-robin
+  (`tenant_weights` / `default_tenant_weight`; nginx-style smooth
+  interleaving, deterministic, credits persist while a tenant is
+  ineligible so starvation earns priority), and
+  `max_inflight_per_tenant` caps one tenant's concurrently active
+  streams so a burst cannot occupy every slot.
+- **Progress guard**: `run()` derives a step bound from the queued
+  work and raises `SchedulerStalled` (queue/active/suspended/backlog
+  state in the message) instead of spinning forever on an engine bug.
+
+Chaos drivers (`resilience.fault_injection`, wired through
+`LoadGenerator(step_hook=...)`): `SlowDecodeStep` inflates chosen
+steps on the virtual clock (latency/deadline pressure moves, token
+streams must not), `StallStream` cancels a stream after N tokens (the
+client that stopped reading), `CancelStorm` cancels a seed-chosen
+subset at chosen steps (the gateway-restart burst).  The tier-1
+acceptance run drives 2x-overload bursts with priorities + deadlines +
+slow steps and asserts every survivor token-identical to its
+unperturbed run, with high-priority p99 TTFT and goodput strictly
+better than same-workload FIFO.  Control-plane activity rides
+`apex_serving_{preempted,cancelled,shed}_total` and the per-tenant
+`apex_serving_tenant_inflight` gauge.
+
 ## Open-loop load generation (`serving.loadgen`)
 
 The bench's staggered streams are *closed-loop* (a new request submits
@@ -900,6 +976,10 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_block_pool_utilization` | gauge | scheduler, every step while a paged engine serves (allocated KV pool blocks / allocatable blocks) |
 | `apex_serving_block_alias_hits_total` | counter | `serving_block_alias` events (prefix-cache blocks reused by table aliasing — zero-copy hits) |
 | `apex_serving_block_cow_total` | counter | `serving_block_cow` events (copy-on-write block copies — a write hit a shared block) |
+| `apex_serving_preempted_total` | counter | `serving_request_preempted` events (DECODE streams losslessly evicted by a higher-priority admission; each resumes bit-exactly) |
+| `apex_serving_cancelled_total` | counter | `serving_request_cancelled` events (caller-cancelled requests; slot/blocks/pins released) |
+| `apex_serving_shed_total` | counter | `serving_request_shed` events (expired-deadline evictions before further prefill spend; charged against goodput) |
+| `apex_serving_tenant_inflight{tenant}` | gauge | scheduler, every step while a scheduling policy is enabled (active streams per tenant) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -956,7 +1036,12 @@ finished, with exact phase boundaries on an injectable clock
 (`queue_wait_s` / `prefill_s` / `decode_s` sum to `total_s` within
 1 µs — the four stamps are shared), slot id, and
 speculation / prefix-cache / paged-aliasing annotations matched from
-the event payloads.  Default-off like spans: with no recorder
+the event payloads.  Control-plane terminals close records too: a
+cancelled or shed request keeps whatever stamps it earned
+(`finish_reason` says why it died; incomplete records are counted,
+never distributed), and preemption cycles annotate the record
+(`preemptions` + per-gap `t_preempted`/`t_resumed` stamps, rendered
+as `preempted` slices inside the decode track).  Default-off like spans: with no recorder
 installed nothing runs and the event/metric stream is untouched
 (tier-1 pins the identity **and** an instrumented-vs-bare scheduler
 step bound ≤ 1.10× with a recorder installed).  Exports follow the
@@ -974,8 +1059,10 @@ folds a recorder's records into an `SLOReport`: **nearest-rank**
 p50/p95/p99 (+ mean/min/max) over the exact per-request samples for
 TTFT (submit → first token), TPOT (decode seconds per generated token
 past the first), queue wait, and end-to-end latency, plus goodput
-(requests meeting their deadline / requests *offered* — shed and
-unfinished requests count against it) and throughput.
+(requests meeting their deadline / requests *offered* — shed,
+cancelled, and unfinished requests count against it; full service is
+required, so a record whose `finish_reason` is `cancelled`/`shed`
+can never count as met) and throughput.
 `SLOReport.to_dict()` is a stable rounded JSON-ready dict (the
 `bench.py serving_slo` block's payload; diffable by
 `tools/bench_compare.py`).  `Histogram.quantile(q)` gives the
@@ -1427,6 +1514,48 @@ cross-checks the scrape-side estimates against the exact samples.
 measured sustainable load; compare rounds with
 `python tools/bench_compare.py OLD.json NEW.json` (exit 1 on any
 metric regression beyond tolerance).
+
+Keep p99 for paying tenants under overload — a 2x burst doubles
+everyone's p99 under FIFO; the serving control plane protects the
+tier that paid for latency, losslessly
+([serving page](api/serving.md)):
+
+```python
+from apex_tpu import serving as sv
+
+sched = sv.ContinuousBatchingScheduler(
+    eng, max_queue=256,
+    policy=sv.SchedulingPolicy(
+        tenant_weights={"paid": 3.0},      # smooth WRR within a class
+        max_inflight_per_tenant=6,         # no tenant owns every slot
+        preemption=True,                   # evict lower priority...
+        deadline_shedding=True))           # ...and shed the expired
+
+# the paying tier: high priority, tight completion deadline
+sched.submit(sv.Request("chat-1", prompt, max_new_tokens=128, eos_id=2,
+                        priority=10, deadline_s=2.0, tenant="paid"))
+# batch traffic: default priority, loose deadline
+sched.submit(sv.Request("batch-7", doc, max_new_tokens=512,
+                        deadline_s=60.0, tenant="batch"))
+
+results = sched.run()   # raises SchedulerStalled on a wedged engine
+sched.cancel("batch-7") # a disconnected client frees its slot/blocks
+```
+
+When `chat-1` arrives with every slot busy, the lowest-priority DECODE
+stream is **preempted losslessly**: its cache bytes are captured
+(dense: bucketed region reads; paged: block references — zero copies),
+the slot serves the paying request, and the victim later resumes
+**bit-exactly** — same f32 logits, same tokens, reported as
+`finish_reason="preempted-resumed"`.  Queued requests whose deadline
+already passed are shed before they waste prefill budget, and both
+sheds and cancellations are charged against goodput (full service or
+it didn't count).  A scheduler without `policy=` stays byte-for-byte
+FIFO.  `bench.py`'s `serving_slo.policy` block runs the same
+overloaded workload FIFO-vs-policy and records the honest
+high-priority p99 TTFT and goodput deltas in `PERF_NOTES.md`; chaos
+drivers (`SlowDecodeStep`, `StallStream`, `CancelStorm`) let tier-1
+prove every surviving stream is token-identical under fire.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

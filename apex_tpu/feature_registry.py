@@ -84,3 +84,7 @@ register("serving_policy", "serving control plane: priority classes with lossles
          "(bit-exact) preemption, cancellation, deadline shedding, per-tenant "
          "weighted-round-robin fairness + serving chaos injection",
          False, "host scheduler + existing capture/restore/alias programs")
+register("serving_tp", "tensor-parallel serving: DecodeEngine sharded over a 1-D "
+         "tp mesh (Megatron column/row params, head-split KV cache, replicated "
+         "tables/lengths; token-identical greedy streams, one psum pair per layer)",
+         False, "shard_map over the same jitted serving programs")

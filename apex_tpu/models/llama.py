@@ -45,7 +45,8 @@ from apex_tpu.transformer.tensor_parallel import (
 )
 from apex_tpu.transformer.tensor_parallel.utils import divide
 
-__all__ = ["LlamaConfig", "LlamaForCausalLM"]
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "tp_param_spec",
+           "validate_tp_divisibility"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,6 +79,70 @@ class LlamaConfig:
         return cls(vocab_size=128256, intermediate_size=14336,
                    num_key_value_heads=8, rope_theta=500000.0,
                    max_position_embeddings=8192)
+
+
+# which flax param leaves the tensor_parallel layers shard, by module
+# name — the model owns this layout knowledge (engine/weights derive
+# their NamedShardings from it instead of re-guessing the Megatron
+# column/row split from shapes)
+_TP_COLUMN_MODULES = ("q_proj", "k_proj", "v_proj", "gate_proj",
+                      "up_proj")
+_TP_ROW_MODULES = ("o_proj", "down_proj")
+
+
+def tp_param_spec(path, axis_name: str = TENSOR_PARALLEL_AXIS):
+    """``PartitionSpec`` for one Llama param leaf under a 1-D tp mesh.
+
+    ``path`` is a ``jax.tree_util`` key path (or its ``keystr`` string)
+    of a leaf of the flax params tree.  The mapping mirrors what the
+    tensor_parallel layers build per rank:
+
+    - ``embed_tokens.embedding`` and ``lm_head``: ``[vocab/tp, h]``
+      (vocab-parallel) -> ``P(axis, None)``;
+    - Column-parallel kernels (q/k/v/gate/up): ``[in, out/tp]`` ->
+      ``P(None, axis)``; their biases ``[out/tp]`` -> ``P(axis)``;
+    - Row-parallel kernels (o_proj/down_proj): ``[in/tp, out]`` ->
+      ``P(axis, None)``; their biases are added after the psum,
+      replicated -> ``P()``;
+    - everything else (norm scales): replicated -> ``P()``.
+
+    Serving uses this to lay params out on the decode engine's mesh
+    (:class:`apex_tpu.serving.engine.DecodeEngine` with ``tp=``) and to
+    restore checkpoints directly onto it
+    (:func:`apex_tpu.serving.weights.load_serving_params`).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    ks = path if isinstance(path, str) else jax.tree_util.keystr(path)
+    if "embedding" in ks or "lm_head" in ks:
+        return P(axis_name, None)
+    column = any(m in ks for m in _TP_COLUMN_MODULES)
+    row = any(m in ks for m in _TP_ROW_MODULES)
+    if "kernel" in ks:
+        if column:
+            return P(None, axis_name)
+        if row:
+            return P(axis_name, None)
+    if "bias" in ks and column:
+        return P(axis_name)
+    return P()
+
+
+def validate_tp_divisibility(config: "LlamaConfig", tp: int) -> None:
+    """Raise ``ValueError`` unless every tp-sharded dimension divides by
+    ``tp`` — attention heads and kv heads (head-wise KV-cache shard),
+    vocab (embedding + lm_head), and the MLP intermediate width."""
+    tp = int(tp)
+    for what, dim in (("num_attention_heads", config.num_attention_heads),
+                      ("kv_heads", config.kv_heads),
+                      ("vocab_size", config.vocab_size),
+                      ("intermediate_size", config.intermediate_size)):
+        if dim % tp:
+            raise ValueError(
+                f"{what}={dim} is not divisible by tp={tp} — every "
+                f"tensor-parallel shard must be equal-sized (heads, kv "
+                f"heads, vocab rows, and MLP intermediate columns are "
+                f"the sharded dimensions)")
 
 
 def _rope_freqs(s: int, dim: int, theta: float, offset=0) -> jax.Array:

@@ -164,6 +164,18 @@ SERVING_SHED = metrics.counter(
     "apex_serving_shed_total",
     "queued or suspended requests shed at an expired deadline before "
     "spending further prefill budget (charged against goodput)")
+SERVING_TP_SIZE = metrics.gauge(
+    "apex_serving_tp_size",
+    "tensor-parallel mesh width the decode engine's programs run over "
+    "(1 == single-chip; set from serving_tp_step events)")
+SERVING_COLLECTIVE_SECONDS = metrics.histogram(
+    "apex_serving_collective_seconds",
+    "wall time of one tensor-parallel decode step, dispatch to "
+    "completion — an honest UPPER BOUND on the per-step collective "
+    "cost (the per-layer psum pair rides inside; exact attribution "
+    "needs a profiler)",
+    buckets=tuple(b / 1e3 for b in (0.25, 0.5, 1, 2, 5, 10, 25, 50,
+                                    100, 250, 1000)))
 SERVING_TENANT_INFLIGHT = metrics.gauge(
     "apex_serving_tenant_inflight",
     "active decode/prefill streams per tenant (refreshed per scheduler "
@@ -293,6 +305,15 @@ def _on_serving_request_finished(event: dict) -> None:
         SERVING_TOKENS_PER_S.set(tokens_per_s)
 
 
+def _on_serving_tp_step(event: dict) -> None:
+    tp = _measurement(event, "tp")
+    if tp is not None and tp >= 1:
+        SERVING_TP_SIZE.set(tp)
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_COLLECTIVE_SECONDS.observe(duration_s)
+
+
 _HANDLERS = {
     "retry_attempt": _on_retry_attempt,
     "retry_exhausted": _on_retry_exhausted,
@@ -314,6 +335,7 @@ _HANDLERS = {
     "serving_request_cancelled": _on_serving_request_cancelled,
     "serving_request_shed": _on_serving_request_shed,
     "serving_request_finished": _on_serving_request_finished,
+    "serving_tp_step": _on_serving_tp_step,
 }
 
 

@@ -33,6 +33,12 @@ head matmul), its amp policies, and its resilience checkpoints:
   greedy/temperature/top-k sampling from explicit PRNG keys.  Prefill
   AND cached incremental decode are bit-identical to the shape-stable
   uncached full-context forward (the tier-1 acceptance tests).
+  Opt-in **tensor parallelism** (``tp=TPConfig(size=N)``) wraps the
+  same program bodies in ``shard_map`` over a 1-D serving mesh:
+  params take the training stack's Megatron column/row split, the KV
+  cache shards head-wise, lengths/tables replicate, and tp=2/4 greedy
+  streams stay token-identical to the single-chip engine (logits
+  argmax-tier — the psum's reduction order genuinely differs).
 - :mod:`.draft` — prompt-lookup drafting for **exact-greedy
   speculative decoding**: a host-side longest-suffix n-gram match over
   each request's prompt + generated history proposes up to k candidate
@@ -85,8 +91,10 @@ head matmul), its amp policies, and its resilience checkpoints:
   queue-wait and goodput SLO reports.
 - :mod:`.weights` — :func:`load_serving_params`: newest *valid* step
   from a resilience checkpoint root (v1 whole-tree and v2 sharded both
-  work), params subtree selection, and bf16 serving casts through
-  ``amp.policy``.
+  work), params subtree selection, bf16 serving casts through
+  ``amp.policy``, and mesh-direct restore for tensor-parallel serving
+  (``shardings=tp_param_shardings(...)`` places every leaf onto the
+  serving mesh inside the restore itself — no host-replicated detour).
 
 End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
 
@@ -120,11 +128,13 @@ from apex_tpu.serving.loadgen import (
 )
 from apex_tpu.serving.engine import (
     DecodeEngine,
+    TPConfig,
     default_draft_buckets,
     default_prefill_buckets,
     request_key,
     sample_tokens,
     token_key,
+    tp_param_shardings,
 )
 from apex_tpu.serving.kv_cache import (
     KVCache,
@@ -173,6 +183,8 @@ __all__ = [
     "PrefixCache",
     "PrefixCacheConfig",
     "DecodeEngine",
+    "TPConfig",
+    "tp_param_shardings",
     "SpeculationConfig",
     "adapt_k",
     "default_draft_buckets",

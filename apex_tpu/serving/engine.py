@@ -56,12 +56,14 @@ reproduces its exact token stream.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.serving.kv_cache import (
@@ -78,13 +80,55 @@ from apex_tpu.serving.paged_kv_cache import (
     blocks_per_slot,
     init_paged_cache,
 )
-from apex_tpu.utils.compat import compile_count
+from apex_tpu.utils.compat import (
+    NO_REP_CHECK,
+    SERVING_TP_AXIS,
+    compile_count,
+    serving_mesh,
+    shard_map,
+)
 
-__all__ = ["DecodeEngine", "default_prefill_buckets",
+__all__ = ["DecodeEngine", "TPConfig", "default_prefill_buckets",
            "default_draft_buckets", "sample_tokens", "request_key",
-           "token_key"]
+           "token_key", "tp_param_shardings"]
 
 logger = get_logger("serving.engine")
+
+
+@dataclasses.dataclass(frozen=True)
+class TPConfig:
+    """Opt-in tensor-parallel serving over a 1-D ``size``-chip mesh.
+
+    ``DecodeEngine(..., tp=TPConfig(size=2))`` lays the serving params
+    out with the Megatron column/row split the training forward already
+    uses, shards the KV cache head-wise (dense ``[layers, slots,
+    max_len, kv_heads/tp, head_dim]`` and the paged block pool alike),
+    replicates slot lengths and block tables, and wraps every compiled
+    program family in ``shard_map`` over the mesh — so the per-layer
+    psum pair (attention o_proj + MLP down_proj) runs exactly as it
+    does in training.  The default (``tp=None``) keeps the single-chip
+    engine byte-for-byte untouched.
+    """
+
+    size: int
+
+    def __post_init__(self):
+        if int(self.size) < 1:
+            raise ValueError(f"tp size must be >= 1, got {self.size}")
+
+
+def tp_param_shardings(params, mesh) -> "jax.tree_util.PyTreeDef":
+    """Per-leaf :class:`NamedSharding` tree for serving params on a tp
+    mesh, derived from :func:`apex_tpu.models.llama.tp_param_spec` (the
+    model owns its column/row layout).  Hand this to
+    :func:`apex_tpu.serving.weights.load_serving_params` to restore a
+    checkpoint *directly onto the serving mesh* — no host-replicated
+    detour — or ``jax.device_put`` a host tree with it."""
+    from apex_tpu.models.llama import tp_param_spec
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, tp_param_spec(
+            path, SERVING_TP_AXIS)), params)
 
 
 def _sample_one(logits, base_key, index, temperature, top_k):
@@ -197,7 +241,8 @@ class DecodeEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  draft_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=None,
-                 paged: Optional[PagedCacheConfig] = None):
+                 paged: Optional[PagedCacheConfig] = None,
+                 tp: Optional[TPConfig] = None):
         if prefill_len < 2:
             raise ValueError("prefill_len must be >= 2 (a length-1 "
                              "prefill is indistinguishable from a decode "
@@ -242,6 +287,16 @@ class DecodeEngine:
         self.model = model
         self.params = params
         self.slots = int(slots)
+        # opt-in tensor parallelism: validate the head/vocab split up
+        # front (a bad divisor must fail at construction, not as an XLA
+        # sharding error three calls later) and build the serving mesh.
+        # tp=None (the default) leaves every code path below untouched.
+        self._tp_cfg = tp
+        self._mesh = None
+        if tp is not None:
+            from apex_tpu.models.llama import validate_tp_divisibility
+            validate_tp_divisibility(model.config, tp.size)
+            self._mesh = serving_mesh(tp.size)
         self.max_len = int(max_len)
         self.prefill_len = int(prefill_len)
         self.prefill_buckets = buckets
@@ -288,8 +343,46 @@ class DecodeEngine:
         else:
             fresh = init_cache(model.config, slots=slots, max_len=max_len,
                                dtype=cache_dtype)
-        self._device = jax.local_devices()[0]
-        self._cache = jax.device_put(fresh, self._device)
+        if tp is None:
+            # _host_target is where host-side snapshots (table flushes,
+            # length mirrors, restore chunks) get committed before a
+            # dispatch — the single local device here, a replicated
+            # NamedSharding under tp.  Same committed-placement rule
+            # either way.
+            self._device = jax.local_devices()[0]
+            self._host_target = self._device
+            self._cache_specs = None
+            self._cache = jax.device_put(fresh, self._device)
+        else:
+            self._device = jax.local_devices()[0]
+            P = PartitionSpec
+            # head-wise cache split: dense [layers, slots, max_len,
+            # kv_heads, head_dim] and the paged pool [layers, blocks,
+            # block_size, kv_heads, head_dim] both carry kv_heads on
+            # axis 3; lengths and block tables are replicated (every
+            # rank needs them to mask/route identically)
+            # no trailing None: jit outputs carry the canonical short
+            # spec, and the init-time placement must hash identically
+            # or the first post-decode prefill retraces
+            kvspec = P(None, None, None, SERVING_TP_AXIS)
+            self._cache_specs = jax.tree_util.tree_map_with_path(
+                lambda path, _: (kvspec
+                                 if jax.tree_util.keystr(path) in (".k", ".v")
+                                 else P()), fresh)
+            self._host_target = NamedSharding(self._mesh, P())
+            # restore/read chunks are [layers, rows, kv_heads, head_dim]
+            # — kv_heads on axis 2 outside the cache container
+            self._kv_chunk_sharding = NamedSharding(
+                self._mesh, P(None, None, SERVING_TP_AXIS))
+            cache_shardings = jax.tree.map(
+                lambda s: NamedSharding(self._mesh, s), self._cache_specs,
+                is_leaf=lambda x: isinstance(x, PartitionSpec))
+            self._cache = jax.device_put(fresh, cache_shardings)
+            # lay the params out column/row-split on the mesh (a no-op
+            # transfer when weights.load_serving_params already restored
+            # them onto this very layout)
+            self.params = jax.device_put(
+                params, tp_param_shardings(params, self._mesh))
         # slots whose K/V arrived via restore_prefix (slot -> restored
         # token count): the ONLY slots prefill() accepts a nonzero
         # resume offset for — an arbitrary occupied slot is still
@@ -360,6 +453,14 @@ class DecodeEngine:
             logits, cache = model.apply(params, ids, kv_cache=cache,
                                         slot=slot, position=offset)
             rows = logits[:, 0, :].astype(jnp.float32)   # [W, vocab]
+            if tp is not None:
+                # under shard_map each rank holds only its vocab shard
+                # of the rows; acceptance must argmax the FULL vocab
+                # identically on every rank (a shard-local argmax would
+                # diverge per rank and corrupt the replicated committed
+                # length), so gather the shards back before deciding
+                rows = lax.all_gather(rows, SERVING_TP_AXIS, axis=1,
+                                      tiled=True)
             greedy = jnp.argmax(rows, axis=-1).astype(jnp.int32)
             w = ids.shape[1]
             real = jnp.arange(w - 1, dtype=jnp.int32) < (length - 1)
@@ -410,15 +511,67 @@ class DecodeEngine:
         # the cache argument is donated: the engine discards the old
         # functional copy on every call, and without aliasing each
         # one-token step would copy the whole preallocated k/v pair
-        self._prefill = jax.jit(_prefill, donate_argnums=(1,))
-        self._decode = jax.jit(_decode, donate_argnums=(1,))
-        self._verify = jax.jit(_verify, donate_argnums=(1,))
-        self._restore = jax.jit(_restore, donate_argnums=(0,))
-        self._cow = jax.jit(_cow, donate_argnums=(0,))
-        # NOT donated: a region read must leave the cache intact, and
-        # its outputs are fresh owned buffers the prefix cache keeps
-        # alive across later (donating) engine calls
-        self._read = jax.jit(_read, static_argnames=("n",))
+        if tp is None:
+            self._prefill = jax.jit(_prefill, donate_argnums=(1,))
+            self._decode = jax.jit(_decode, donate_argnums=(1,))
+            self._verify = jax.jit(_verify, donate_argnums=(1,))
+            self._restore = jax.jit(_restore, donate_argnums=(0,))
+            self._cow = jax.jit(_cow, donate_argnums=(0,))
+            # NOT donated: a region read must leave the cache intact,
+            # and its outputs are fresh owned buffers the prefix cache
+            # keeps alive across later (donating) engine calls
+            self._read = jax.jit(_read, static_argnames=("n",))
+        else:
+            # tensor-parallel wiring: the SAME program bodies, wrapped
+            # in shard_map over the serving mesh inside the same jit
+            # (donation included).  The tensor_parallel layers probe
+            # the mapped axis via tp_world_size("tp") — bound inside
+            # the shard_map they shard automatically, so model code
+            # needs no serving-specific branches, and each family still
+            # compiles the same bounded program count (asserted in
+            # tests/test_serving_tp.py via the same compile witnesses).
+            from apex_tpu.models.llama import tp_param_spec
+            P = PartitionSpec
+            TP = SERVING_TP_AXIS
+            mesh = self._mesh
+            cspec = self._cache_specs
+            pspec = jax.tree_util.tree_map_with_path(
+                lambda path, _: tp_param_spec(path, TP), params)
+            blk = P(None, None, TP, None)   # [layers, rows, kvh, hd]
+            S = P()                         # replicated scalars/ids
+
+            def smap(body, in_specs, out_specs):
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, **NO_REP_CHECK)
+
+            self._prefill = jax.jit(
+                smap(_prefill, (pspec, cspec, S, S, S, S),
+                     (P(TP), cspec)), donate_argnums=(1,))
+            self._decode = jax.jit(
+                smap(_decode, (pspec, cspec, S, S),
+                     (P(None, TP), cspec)), donate_argnums=(1,))
+            # verify's greedy/rows/accepted leave replicated: the body
+            # all_gathers the vocab shards before the argmax decides
+            self._verify = jax.jit(
+                smap(_verify, (pspec, cspec, S, S, S, S),
+                     (S, S, S, cspec)), donate_argnums=(1,))
+            self._restore = jax.jit(
+                smap(_restore, (cspec, blk, blk, S, S, S), cspec),
+                donate_argnums=(0,))
+            self._cow = jax.jit(
+                smap(_cow, (cspec, S, S), cspec), donate_argnums=(0,))
+
+            def _read_tp(cache, slot, start, *, n):
+                # shard_map takes no static args: bind the extent in a
+                # closure and build the mapped program inside the jit —
+                # one trace per distinct n, exactly like the plain
+                # static_argnames form (and still NOT donated)
+                def body(c, s, t):
+                    return _read(c, s, t, n=n)
+                return smap(body, (cspec, S, S), (blk, blk))(
+                    cache, slot, start)
+
+            self._read = jax.jit(_read_tp, static_argnames=("n",))
         logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
                      "buckets=%s cache_dtype=%s", self.slots,
                      self.max_len, self.prefill_len,
@@ -428,6 +581,23 @@ class DecodeEngine:
     @property
     def cache(self) -> KVCache:
         return self._cache
+
+    @property
+    def tp(self) -> Optional[TPConfig]:
+        """The tensor-parallel config, or ``None`` on a single-chip
+        engine."""
+        return self._tp_cfg
+
+    @property
+    def tp_size(self) -> int:
+        """Mesh width the serving programs run over (1 = single-chip)."""
+        return 1 if self._tp_cfg is None else int(self._tp_cfg.size)
+
+    @property
+    def mesh(self):
+        """The 1-D serving tp :class:`jax.sharding.Mesh`, or ``None``
+        on a single-chip engine."""
+        return self._mesh
 
     def lengths(self) -> np.ndarray:
         """Per-slot valid-token counts (0 = free), from the host mirror
@@ -465,8 +635,12 @@ class DecodeEngine:
 
     def reset(self) -> None:
         """Free every slot (keeps compiled programs and allocations)."""
-        self._cache = dataclasses.replace(
-            self._cache, lengths=jnp.zeros((self.slots,), jnp.int32))
+        zeros = (jnp.zeros((self.slots,), jnp.int32)
+                 if self._tp_cfg is None
+                 # replicated committed placement, like _flush_tables
+                 else jax.device_put(np.zeros((self.slots,), np.int32),
+                                     self._host_target))
+        self._cache = dataclasses.replace(self._cache, lengths=zeros)
         self._lengths_host[:] = 0
         self._restored.clear()
         if self._pager is not None:
@@ -542,18 +716,20 @@ class DecodeEngine:
             # committed placement on purpose: an uncommitted jnp array
             # here would make pjit specialize a SECOND executable for
             # the changed placement, breaking the one-decode-compile
-            # contract (same trap as the init-time device_put)
+            # contract (same trap as the init-time device_put).  Under
+            # tp the target is the replicated NamedSharding — tables
+            # and lengths must land identically on every rank.
             kwargs = {"tables": jax.device_put(self._pager.table_snapshot(),
-                                               self._device)}
+                                               self._host_target)}
             if with_lengths:
                 kwargs["lengths"] = jax.device_put(
-                    self._lengths_host.astype(np.int32), self._device)
+                    self._lengths_host.astype(np.int32), self._host_target)
             self._cache = dataclasses.replace(self._cache, **kwargs)
         elif with_lengths:
             self._cache = dataclasses.replace(
                 self._cache,
                 lengths=jax.device_put(self._lengths_host.astype(np.int32),
-                                       self._device))
+                                       self._host_target))
 
     def _ensure_paged(self, writes) -> None:
         """Pre-dispatch allocation for a batch of write spans
@@ -933,6 +1109,12 @@ class DecodeEngine:
                 jnp.asarray(k[:, start:start + n], dtype))
             v_blk = v_blk.at[:, :n].set(
                 jnp.asarray(v[:, start:start + n], dtype))
+            if self._tp_cfg is not None:
+                # commit the chunk head-sharded BEFORE the dispatch:
+                # an uncommitted block would cost a resharding copy
+                # per chunk and a second compiled placement variant
+                k_blk = jax.device_put(k_blk, self._kv_chunk_sharding)
+                v_blk = jax.device_put(v_blk, self._kv_chunk_sharding)
             self._cache = self._restore(
                 self._cache, k_blk, v_blk, np.int32(slot),
                 np.int32(start), np.int32(n))
@@ -966,9 +1148,26 @@ class DecodeEngine:
                 [(int(s), int(self._lengths_host[s]),
                   int(self._lengths_host[s]) + 1)
                  for s in np.flatnonzero(act)])
-        logits, self._cache = self._decode(
-            self.params, self._cache,
-            np.asarray(tokens, np.int32), act)
+        if self._tp_cfg is None:
+            logits, self._cache = self._decode(
+                self.params, self._cache,
+                np.asarray(tokens, np.int32), act)
+        else:
+            # time the step wall-to-wall and publish it as
+            # serving_tp_step: an honest UPPER BOUND on the per-step
+            # collective cost (dispatch + compute + the per-layer psum
+            # pair; exact collective attribution needs a profiler).
+            # The block_until_ready adds ~nothing — the caller samples
+            # from these logits immediately, syncing anyway.  tp=None
+            # emits nothing: the default-off event stream is identical.
+            t0 = time.perf_counter()
+            logits, self._cache = self._decode(
+                self.params, self._cache,
+                np.asarray(tokens, np.int32), act)
+            jax.block_until_ready(logits)
+            emit_event("serving_tp_step", tp=self.tp_size,
+                       active=int(act.sum()),
+                       duration_s=time.perf_counter() - t0)
         self._lengths_host[act] += 1
         return logits
 

@@ -23,6 +23,13 @@ Layout choices:
   Eviction is therefore O(1): zero the length, reuse the slot.
 - Updates are pure functions returning a new :class:`KVCache` (the
   arrays are donated/aliased by XLA under jit); nothing here mutates.
+- Under tensor-parallel serving (``DecodeEngine(..., tp=...)``) the
+  ``kv_heads`` axis is the sharded one — each mesh rank holds
+  ``kv_heads / tp`` head groups of every slot, ``[layers, slots,
+  max_len, kv_heads/tp, head_dim]`` per rank — while ``lengths`` is
+  replicated (every rank must mask identically).  Nothing in this
+  module changes: inside ``shard_map`` these ops see the local shard
+  as an ordinary cache with fewer heads.
 
 Masking exactness: masked attention scores sit at ``-1e30`` (the flash
 kernels' ``_NEG_INF``), so ``exp(masked - max)`` underflows to exactly

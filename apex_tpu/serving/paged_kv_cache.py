@@ -29,6 +29,13 @@ identical, and therefore the logits are **bit-identical** to the dense
 engine (pinned by ``tests/test_serving_paged.py`` against both the
 dense engine and the uncached shape-stable forward).
 
+Under tensor-parallel serving the pool shards exactly like the dense
+cache — ``kv_heads`` is the split axis (``[layers, num_blocks,
+block_size, kv_heads/tp, head_dim]`` per rank) while ``tables`` and
+``lengths`` replicate, so every rank routes rows through the *same*
+block ids and the host-side manager (refcounts, CoW planning) stays
+mesh-oblivious: one table flush commits identically to all ranks.
+
 Layout invariants the device ops rely on:
 
 - **Block 0 is the null block**: never allocated, never read unmasked.

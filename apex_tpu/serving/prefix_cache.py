@@ -52,6 +52,14 @@ device work capture costs is one fixed-extent region read per new
 block).  Opt-in via ``ContinuousBatchingScheduler(...,
 prefix_caching=PrefixCacheConfig(...))``; the default (off) leaves
 every existing serving path byte-for-byte untouched.
+
+Tensor-parallel serving changes nothing in this module either: capture
+reads come back *gathered* (``read_region`` out-specs reassemble the
+full ``kv_heads`` axis), so entries hold mesh-oblivious global arrays,
+and a restore re-shards them head-wise on the way in — a prefix
+captured on a tp engine restores bit-exactly on that engine, which is
+the reuse contract (entries are per-engine owned state, never shared
+across engines of different numerics).
 """
 
 from __future__ import annotations

@@ -1342,7 +1342,11 @@ class ContinuousBatchingScheduler:
                        active_slots=len(self._active),
                        slot_occupancy=round(occupancy, 4),
                        cache_utilization=round(cache_util, 6),
-                       prefill_backlog=backlog)
+                       prefill_backlog=backlog,
+                       # mesh width the step's programs ran over (1 =
+                       # single-chip; getattr so engine doubles in
+                       # tests keep working)
+                       tp=int(getattr(self.engine, "tp_size", 1)))
         return finished
 
     def _derived_step_bound(self) -> int:

@@ -20,6 +20,15 @@ restore (the restore layer's structure check stays authoritative).
 ``amp.policy.O2()``) then casts for half-precision serving — bf16
 matmul weights, norm-like leaves pinned fp32 — the same cast training
 applied, so served numerics match the trained model's eval numerics.
+
+Tensor-parallel serving restores **directly onto the mesh**: pass
+``shardings`` (e.g. :func:`apex_tpu.serving.engine.tp_param_shardings`)
+and every restored params leaf is placed by the restore layer's own
+``leaf_from_numpy`` — both the v1 and v2 loaders flow through it — so
+a tp=8 server never materializes a host-replicated copy of a model
+that only fits sharded.  The format dispatch and newest-valid fallback
+walk are shared between the host and mesh paths
+(:func:`_restore_newest_valid`), not duplicated.
 """
 
 from __future__ import annotations
@@ -37,32 +46,37 @@ __all__ = ["load_serving_params"]
 logger = get_logger("serving.weights")
 
 
-def load_serving_params(root: str, like: Any, *,
-                        params_key: Optional[str] = None,
-                        policy: Any = None,
-                        step: Optional[int] = None) -> tuple[Any, int]:
-    """Restore serving params from checkpoint ``root``.
+def _annotate_shardings(like: Any, params_key: Optional[str],
+                        shardings: Any) -> Any:
+    """Template params leaves -> :class:`jax.ShapeDtypeStruct` carrying
+    the requested sharding.  The restore layers place each loaded leaf
+    with ``leaf_from_numpy(arr, template_leaf)``, which honors a
+    template's ``.sharding`` — annotating the template is therefore the
+    WHOLE mesh-restore mechanism, identical for v1 and v2 manifests."""
+    import jax
 
-    Args:
-      root: a resilience checkpoint root (v1, v2/sharded, or mixed).
-      like: template pytree with the **saved** structure (the full train
-        state the training loop persisted, not just params).
-      params_key: top-level key selecting the params subtree of the
-        restored tree (``None`` = the whole tree is the params).
-      policy: optional :class:`~apex_tpu.amp.policy.PrecisionPolicy`;
-        its ``cast_params`` is applied to the selected subtree (bf16
-        serving with fp32 norms under ``amp.policy.O2()``).
-      step: pin an exact step instead of the newest-valid walk.
+    def ann(leaf, sh):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
 
-    Returns ``(params, step)``.  Raises :class:`CheckpointError` when no
-    valid checkpoint exists (or the pinned step is invalid).
-    """
-    t0 = time.monotonic()
+    target = like if params_key is None else like[params_key]
+    annotated = jax.tree.map(ann, target, shardings)
+    if params_key is None:
+        return annotated
+    # non-params subtrees (optimizer moments, rng, scaler) keep their
+    # host placement — serving discards them right after the restore
+    return {**like, params_key: annotated}
+
+
+def _restore_newest_valid(root: str, like: Any, step: Optional[int]
+                          ) -> tuple[Any, int, dict, bool, str]:
+    """The shared manifest-format dispatch + newest-valid fallback walk
+    (one implementation for the host and mesh restore paths).  Returns
+    ``(tree, step, manifest, sharded, step_dir)``; raises
+    :class:`CheckpointError` when nothing under ``root`` restores."""
     candidates = ([step] if step is not None
                   else list(reversed(_ckpt._list_steps(root))))
     if not candidates:
         raise CheckpointError(f"no checkpoints under {root!r}")
-    tree = None
     errors: list[str] = []
     for got in candidates:
         step_dir = os.path.join(root, _ckpt._step_dirname(got))
@@ -85,7 +99,7 @@ def load_serving_params(root: str, like: Any, *,
                                                        step=got)
             else:
                 tree, got = _ckpt.restore_checkpoint(root, like, step=got)
-            break
+            return tree, got, manifest, sharded, step_dir
         except CheckpointError as e:
             # newest-valid fallback walk, same contract as a training
             # restart (the restore layer already emitted
@@ -93,9 +107,45 @@ def load_serving_params(root: str, like: Any, *,
             errors.append(str(e))
             if step is not None:
                 raise
-    if tree is None:
-        raise CheckpointError(
-            f"no valid checkpoint under {root!r}; rejected: {errors}")
+    raise CheckpointError(
+        f"no valid checkpoint under {root!r}; rejected: {errors}")
+
+
+def load_serving_params(root: str, like: Any, *,
+                        params_key: Optional[str] = None,
+                        policy: Any = None,
+                        step: Optional[int] = None,
+                        shardings: Any = None) -> tuple[Any, int]:
+    """Restore serving params from checkpoint ``root``.
+
+    Args:
+      root: a resilience checkpoint root (v1, v2/sharded, or mixed).
+      like: template pytree with the **saved** structure (the full train
+        state the training loop persisted, not just params).
+      params_key: top-level key selecting the params subtree of the
+        restored tree (``None`` = the whole tree is the params).
+      policy: optional :class:`~apex_tpu.amp.policy.PrecisionPolicy`;
+        its ``cast_params`` is applied to the selected subtree (bf16
+        serving with fp32 norms under ``amp.policy.O2()``).
+      step: pin an exact step instead of the newest-valid walk.
+      shardings: optional sharding pytree matching the *params* subtree
+        (leaf-wise, e.g. :func:`apex_tpu.serving.engine.
+        tp_param_shardings` over a tp serving mesh).  Restored params
+        leaves are placed directly onto those shardings by the restore
+        layer itself — v1 and v2 formats alike, no host-replicated
+        detour — so handing the result to a ``tp``-enabled
+        :class:`~apex_tpu.serving.engine.DecodeEngine` transfers
+        nothing.  With ``params_key`` set, ``like`` must be a mapping
+        at the top level (the annotated params subtree is swapped in).
+
+    Returns ``(params, step)``.  Raises :class:`CheckpointError` when no
+    valid checkpoint exists (or the pinned step is invalid).
+    """
+    t0 = time.monotonic()
+    if shardings is not None:
+        like = _annotate_shardings(like, params_key, shardings)
+    tree, got, manifest, sharded, step_dir = _restore_newest_valid(
+        root, like, step)
     if params_key is not None:
         try:
             tree = tree[params_key]

@@ -311,6 +311,29 @@ def test_serving_metrics_block():
     assert r["config"]["slots"] == 4
 
 
+def test_serving_tp_metrics_block():
+    """The tensor-parallel serving block (ISSUE 15): tp=1 vs tp=2
+    decode ms/token and aggregate tokens/s over one warmed engine pair,
+    the stream-identity witness, and the compile-count guards on BOTH
+    engines — sharding must not add a single extra compile to any
+    program family."""
+    r = bench._serving_tp_metrics(decode_tokens=8, prompt_len=8,
+                                  prefill_len=16, max_len=48, slots=2,
+                                  tp_size=2)
+    assert r["ok"] is True, r
+    # the acceptance witness: greedy streams token-identical across
+    # mesh widths (raw logits are argmax-tier, documented deviation)
+    assert r["streams_identical"] is True
+    for side in ("tp1", "tp2"):
+        assert r[side]["decode_ms_per_token"] > 0.0
+        assert r[side]["aggregate_tokens_per_s"] > 0.0
+        # the compile-count regression guards, sharded and unsharded
+        assert r[side]["decode_compiles"] == 1, r
+        assert r[side]["prefill_compiles"] == 1
+    assert r["tp_vs_single_ratio"] > 0.0
+    assert r["config"]["tp"] == 2
+
+
 def test_serving_spec_metrics_block():
     """The speculative-decode block (ISSUE 9): spec-vs-plain greedy
     decode tokens/s on an acceptance-friendly repetitive workload
@@ -537,13 +560,47 @@ def test_obs_metrics_block():
     assert r["exposition_series"] == 200
 
 
-def test_cpu_smoke_end_to_end(monkeypatch):
-    """The real measurement path on the real (CPU) backend.
+_SMOKE_BLOCK_FNS = (
+    "_recovery_metrics", "_ckpt_async_metrics", "_supervisor_metrics",
+    "_elastic_metrics", "_serving_metrics", "_serving_tp_metrics",
+    "_serving_spec_metrics", "_serving_prefix_metrics",
+    "_serving_paged_metrics", "_serving_slo_metrics", "_obs_metrics")
+
+
+def test_cpu_smoke_train_step_timing(monkeypatch):
+    """The timing protocol on the real (CPU) backend, diagnostic blocks
+    stubbed out: tier-1 keeps the real-execution train-step path (every
+    block already has its own block test above), the full all-blocks
+    smoke runs under -m slow.
 
     steps=16 + one retry: the t(2N) > 1.2*t(N) sanity gate is a
     real-execution check, not a precision claim, and 2-step timings on a
     loaded CI host can flake it.
     """
+    for fn in _SMOKE_BLOCK_FNS:
+        monkeypatch.setattr(bench, fn,
+                            lambda *a, **k: {"ok": False,
+                                             "skipped": "slim smoke"},
+                            raising=True)
+    for attempt in range(2):
+        try:
+            result = bench.run_config("cpu-smoke", steps=16)
+            break
+        except AssertionError:
+            if attempt:
+                raise
+    assert result["value"] > 0
+    assert result["config"]["loss_end"] < result["config"]["loss0"]
+    for key in ("recovery", "serving", "serving_tp", "obs"):
+        assert result[key] == {"ok": False, "skipped": "slim smoke"}
+
+
+@pytest.mark.slow   # ~107 s: every diagnostic block over one real
+                    # config — each block is tier-1-guarded by its own
+                    # block test above; this is the glue run
+def test_cpu_smoke_end_to_end(monkeypatch):
+    """The real measurement path on the real (CPU) backend, every
+    diagnostic block live."""
     for attempt in range(2):
         try:
             result = bench.run_config("cpu-smoke", steps=16)
@@ -560,6 +617,12 @@ def test_cpu_smoke_end_to_end(monkeypatch):
     assert result["supervisor"]["ok"] is True
     assert result["elastic"]["ok"] is True
     assert result["serving"]["ok"] is True
+    # tp block: ok under the suite's forced 8 host devices; the
+    # streams-identical witness is the acceptance bar riding along
+    assert result["serving_tp"]["ok"] is True
+    assert result["serving_tp"]["streams_identical"] is True
+    assert result["serving_tp"]["tp1"]["decode_compiles"] == 1
+    assert result["serving_tp"]["tp2"]["decode_compiles"] == 1
     assert result["serving_spec"]["ok"] is True
     assert result["serving_spec"]["streams_identical"] is True
     assert result["serving_prefix"]["ok"] is True

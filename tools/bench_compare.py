@@ -72,7 +72,11 @@ _INFORMATIONAL_EXACT = ("n", "burst", "steps", "period_s",
                         # shape, not a graded rate (the graded outcomes
                         # are hp_ttft_p99_s / goodput / the deltas)
                         "preempted", "resumed", "cancelled",
-                        "hp_served")
+                        "hp_served",
+                        # the serving_tp block's mesh width is workload
+                        # shape (exact-final-segment on purpose: a bare
+                        # substring "tp" would swallow "tpot")
+                        "tp")
 
 
 class Leaf(NamedTuple):

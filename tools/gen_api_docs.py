@@ -731,6 +731,59 @@ streams asserted token-identical, restore compiles bounded; the
 `serving_paged` block repeats the shared-prompt workload on a paged
 engine, where hits alias instead of copy.
 
+## Tensor-parallel serving (`tp=TPConfig(size=N)`)
+
+`DecodeEngine(..., tp=TPConfig(size=N))` shards every serving program
+over a 1-D `N`-chip mesh (`utils.compat.serving_mesh`); the default
+`tp=None` leaves the single-chip engine byte-for-byte untouched (the
+tier-1 identity test pins the event stream and metric snapshot).  The
+wiring is deliberately thin — the *same* program bodies, wrapped in
+`shard_map` inside the same donating `jax.jit`:
+
+- **Params** lay out with the training stack's Megatron column/row
+  split (`models.llama.tp_param_spec`): q/k/v/gate/up kernels are
+  column-split `P(None, "tp")`, o/down kernels row-split
+  `P("tp", None)`, the vocab-parallel embedding and LM head
+  `P("tp", None)`; norms replicate.  The `tensor_parallel` layers probe
+  the mapped axis via `tp_world_size("tp")` — bound inside the
+  shard_map they shard automatically, so the model needs no
+  serving-specific branches.
+- **KV cache** shards head-wise: dense
+  `[layers, slots, max_len, kv_heads/tp, head_dim]` and the paged block
+  pool `[layers, blocks, block_size, kv_heads/tp, head_dim]` alike
+  (each rank attends its own kv-head group locally — attention needs
+  no collective).  Slot lengths and block tables replicate: every rank
+  must mask and route identically, and the host mirrors flush to a
+  replicated `NamedSharding` so placement never forks an extra
+  compiled variant.
+- **Collective cost model**: one psum pair per layer (after the
+  attention's row-parallel o_proj and the MLP's down_proj) plus one
+  psum in the vocab-parallel embedding — exactly the training
+  forward's collectives, `2L + 1` allreduces of `[tokens, hidden]` per
+  dispatch.  At decode (1 token/slot) the payload is tiny and latency-
+  bound: this is the new hot path the `apex_serving_collective_seconds`
+  histogram watches, and the quantized-allreduce literature (EQuARX)
+  is the compression playbook when it dominates.
+- **Bit-exactness**: greedy token *streams* at tp=2 and tp=4 are
+  asserted identical to the single-chip engine, and all cache-layout
+  invariants (chunk splits, speculation, prefix restore, CoW
+  isolation, preempt/resume) hold sharded.  Raw *logits* are
+  argmax-tier (~1e-7 abs at test scale), not bit-equal: the
+  row-parallel psum splits each contraction into `tp` partial sums, so
+  floating-point reduction order genuinely differs — the documented
+  deviation class, pinned by tolerance + exact-argmax assertions.
+  Within one mesh width everything stays bit-exact: verify all_gathers
+  the vocab shards before acceptance argmaxes, so rollback depths are
+  rank-identical, and capture → restore → resume on the same tp engine
+  reproduces the stream bit-for-bit.
+- **Weights land on the mesh directly**:
+  `weights.load_serving_params(..., shardings=
+  engine.tp_param_shardings(params_like, mesh))` annotates the
+  restore template so both the v1 and v2 loaders place every leaf via
+  `leaf_from_numpy` onto its `NamedSharding` — a tp=8 server never
+  materializes a host-replicated copy of a model that only fits
+  sharded.
+
 ## Determinism guarantees
 
 - **Prefill and greedy decode are bit-identical to the uncached
@@ -769,8 +822,13 @@ wall time — feeding the speculation counters and the
 `serving_first_token` (TTFT), `serving_request_finished`
 (tokens/s, per-token latency, finish reason), `serving_prefix_hit` /
 `serving_prefix_miss` (admission-time prefix-cache outcome; hits
-carry `saved_tokens` + restore wall time), and a periodic
-`serving_step` sample (queue depth, active slots, prefill backlog).
+carry `saved_tokens` + restore wall time), a periodic
+`serving_step` sample (queue depth, active slots, prefill backlog,
+mesh width), and — on a tensor-parallel engine only — a
+`serving_tp_step` per decode dispatch (mesh width + wall time,
+feeding the `apex_serving_tp_size` gauge and the
+`apex_serving_collective_seconds` histogram; a `tp=None` engine emits
+nothing new).
 `bench.py` captures a `serving` block — prefill tokens/s, steady-state
 decode ms/token, continuous-batching aggregate throughput at 1/4/8
 concurrent streams with staggered arrivals (4 concurrent streams ≥ 2×
@@ -980,6 +1038,8 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_cancelled_total` | counter | `serving_request_cancelled` events (caller-cancelled requests; slot/blocks/pins released) |
 | `apex_serving_shed_total` | counter | `serving_request_shed` events (expired-deadline evictions before further prefill spend; charged against goodput) |
 | `apex_serving_tenant_inflight{tenant}` | gauge | scheduler, every step while a scheduling policy is enabled (active streams per tenant) |
+| `apex_serving_tp_size` | gauge | `serving_tp_step` events (tensor-parallel mesh width the decode programs run over; 1 == single-chip) |
+| `apex_serving_collective_seconds` | histogram | `serving_tp_step` events (tp decode step wall time, dispatch → completion — an upper bound on per-step collective cost) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -1342,6 +1402,31 @@ sched = sv.ContinuousBatchingScheduler(
 sched.submit(sv.Request("r0", prompt_ids, max_new_tokens=128, eos_id=2,
                         temperature=0.7, top_k=40, seed=7))
 results = sched.run()          # rid -> RequestResult (tokens, TTFT, tps)
+```
+
+Serve a model too big for one chip — opt the same engine onto a
+tensor-parallel mesh: params restore column/row-split directly onto
+the mesh (no host-replicated copy of a model that only fits sharded),
+the KV cache shards head-wise, and every serving feature — prefix
+caching, speculation, paged CoW, lossless preemption — runs unchanged
+over it.  Greedy streams stay token-identical to a single-chip engine;
+the per-layer psum pair is the new hot path, watched by
+`apex_serving_collective_seconds` ([full page](api/serving.md)):
+
+```python
+from apex_tpu.utils.compat import serving_mesh
+
+mesh = serving_mesh(8)                     # 1-D "tp" mesh, 8 chips
+params, step = sv.load_serving_params(
+    "/ckpts/run7", like=template, params_key="params",
+    policy=amp.policy.O2(),
+    shardings=sv.tp_param_shardings(template["params"], mesh))
+eng = sv.DecodeEngine(model, params, slots=8, max_len=2048,
+                      prefill_len=256, tp=sv.TPConfig(size=8))
+sched = sv.ContinuousBatchingScheduler(eng, max_queue=64,
+                                       prefill_budget=256)
+# (on CPU, export XLA_FLAGS=--xla_force_host_platform_device_count=8
+#  before jax initializes to rehearse the mesh without TPUs)
 ```
 
 Slots admit from the bounded FIFO queue at every step boundary and free

@@ -180,6 +180,17 @@ SERVING_TENANT_INFLIGHT = metrics.gauge(
     "apex_serving_tenant_inflight",
     "active decode/prefill streams per tenant (refreshed per scheduler "
     "step while a scheduling policy is enabled)", ("tenant",))
+SERVING_WEIGHTS_STEP = metrics.gauge(
+    "apex_serving_weights_step",
+    "training step of the weights currently serving (set at boot load "
+    "and on every hot swap/rollback — a fleet dashboard's 'what am I "
+    "running' answer)")
+SERVING_RELOAD_DURATION = metrics.histogram(
+    "apex_serving_reload_duration_seconds",
+    "hot-reload phase wall time: restore (checkpoint read+validate+"
+    "place), validate (pre-swap spec gate), swap (pointer swap + "
+    "prefix-cache invalidation — the only phase the serving loop "
+    "ever waits on)", ("phase",))
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -314,6 +325,27 @@ def _on_serving_tp_step(event: dict) -> None:
         SERVING_COLLECTIVE_SECONDS.observe(duration_s)
 
 
+def _on_serving_weights_loaded(event: dict) -> None:
+    step = _measurement(event, "step")
+    if step is not None:
+        SERVING_WEIGHTS_STEP.set(step)
+    # the load event's duration IS the restore phase (boot and reload
+    # flow through the same load_serving_params call)
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_RELOAD_DURATION.observe(duration_s, phase="restore")
+
+
+def _on_serving_weights_swapped(event: dict) -> None:
+    step = _measurement(event, "step")
+    if step is not None:
+        SERVING_WEIGHTS_STEP.set(step)
+    for phase in ("validate", "swap"):
+        v = _measurement(event, f"{phase}_s")
+        if v is not None:
+            SERVING_RELOAD_DURATION.observe(v, phase=phase)
+
+
 _HANDLERS = {
     "retry_attempt": _on_retry_attempt,
     "retry_exhausted": _on_retry_exhausted,
@@ -336,6 +368,8 @@ _HANDLERS = {
     "serving_request_shed": _on_serving_request_shed,
     "serving_request_finished": _on_serving_request_finished,
     "serving_tp_step": _on_serving_tp_step,
+    "serving_weights_loaded": _on_serving_weights_loaded,
+    "serving_weights_swapped": _on_serving_weights_swapped,
 }
 
 

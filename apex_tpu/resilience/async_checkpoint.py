@@ -202,6 +202,16 @@ class AsyncCheckpointer:
         lc = self._last_committed
         return lc[1] if lc is not None else None
 
+    @property
+    def committed_step(self) -> Optional[int]:
+        """Step of the newest committed checkpoint, or None — the
+        single-field read a serving-side
+        :class:`~apex_tpu.serving.reload.WeightWatcher` polls every
+        scheduler step (same torn-pair-free atomic read as
+        ``last_committed``)."""
+        lc = self._last_committed
+        return lc[0] if lc is not None else None
+
     def poll(self) -> Optional[SaveFuture]:
         """Non-blocking harvest: return and CLEAR the tracked future if
         its write has completed (else None).  The step-boundary call —

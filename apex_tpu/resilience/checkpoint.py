@@ -699,14 +699,44 @@ def _load_validated(ckpt_dir: str, like: Any) -> tuple[Any, int]:
     return jax.tree_util.tree_unflatten(treedef, leaves), manifest["step"]
 
 
+def in_flight_steps(root: str) -> set:
+    """Steps an in-process writer is producing under ``root`` RIGHT NOW
+    (the live-writer registry's view).  A reader walking the root —
+    ``latest_valid_step``, the serving reload watcher — must skip
+    these: a re-save of an existing step swaps the old dir aside before
+    the new one lands, so the committed dir a concurrent reader sees
+    for an in-flight step can vanish mid-read.  Steps a FOREIGN process
+    is writing are invisible here (single-writer-root contract); their
+    commits are atomic renames, so a reader only ever sees them whole.
+    """
+    root_abs = os.path.abspath(root)
+    with _WRITERS_LOCK:
+        return {s for r, s in _ACTIVE_STEPS if r == root_abs}
+
+
 def latest_valid_step(root: str) -> Optional[int]:
-    """Newest step whose checkpoint passes validation, or None."""
+    """Newest step whose checkpoint passes validation, or None.
+
+    Race-hardened against a live writer sharing the root: steps the
+    live-writer registry marks in flight (an ``AsyncCheckpointer``
+    mid-commit) are skipped rather than half-read, and a step dir that
+    vanishes mid-validation (rotation, or a re-save's aside swap) is
+    treated as invalid-and-skipped instead of aborting the walk with a
+    stray ``FileNotFoundError``."""
+    live = in_flight_steps(root)
     for step in reversed(_list_steps(root)):
+        if step in live:
+            continue
+        step_dir = os.path.join(root, _step_dirname(step))
         try:
-            validate_checkpoint(os.path.join(root, _step_dirname(step)))
+            validate_checkpoint(step_dir)
             return step
         except CheckpointError:
             continue
+        except OSError:
+            if os.path.isdir(step_dir):
+                raise          # environmental I/O error: genuinely fatal
+            continue           # dir vanished under the walk: fall back
     return None
 
 

@@ -86,6 +86,7 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
+    "ReloadStorm",
     "SimulatedPreemption",
     "SimulatedWriterCrash",
     "SlowDecodeStep",
@@ -470,6 +471,41 @@ class CancelStorm:
         for rid in hit:
             scheduler.cancel(rid)
             self.cancelled.append(rid)
+
+
+class ReloadStorm:
+    """Hot-reload pressure: force weight reload attempts at chosen
+    steps while the scheduler is under load.
+
+    Install as a ``step_hook`` alongside an overloaded open-loop
+    workload: at each configured (0-based) step index the hook calls
+    ``reloader.reload()`` (or ``maybe_reload()`` when ``force=False``
+    — then only steps where the watcher actually sees a newer commit
+    reload).  The chaos acceptance contract: however many swaps,
+    refusals, and rollback-fodder candidates the storm generates,
+    every in-flight stream survives and the scheduler's accounting
+    (slots, blocks, pins, queue) stays exact.  ``outcomes`` records
+    each attempt's :class:`~apex_tpu.serving.reload.ReloadOutcome`
+    (or None for a no-op ``maybe_reload``) for assertions.
+    """
+
+    def __init__(self, steps: Iterable[int], *, reloader,
+                 force: bool = False):
+        self.steps = frozenset(int(s) for s in steps)
+        self.reloader = reloader
+        self.force = bool(force)
+        self.outcomes: list = []
+
+    def __call__(self, step: int, scheduler=None) -> None:
+        if int(step) not in self.steps:
+            return
+        emit_event("fault_injected", fault="reload_storm",
+                   step=int(step), forced=self.force)
+        if self.force:
+            out = self.reloader.reload()
+        else:
+            out = self.reloader.maybe_reload()
+        self.outcomes.append(out)
 
 
 # -- pod-scale faults (PR 3) -----------------------------------------------

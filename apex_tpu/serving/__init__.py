@@ -95,6 +95,19 @@ head matmul), its amp policies, and its resilience checkpoints:
   ``amp.policy``, and mesh-direct restore for tensor-parallel serving
   (``shardings=tp_param_shardings(...)`` places every leaf onto the
   serving mesh inside the restore itself — no host-replicated detour).
+- :mod:`.reload` — **zero-downtime weight lifecycle** over a live
+  scheduler: :class:`WeightWatcher` polls for newer *committed*
+  training steps (in-process ``AsyncCheckpointer``, supervisor
+  heartbeat pointer, or registry-aware root walk);
+  :class:`HotReloader` restores the candidate double-buffered through
+  the validated path, gates on a structural/spec check, swaps at a
+  step boundary with in-flight streams preserved and the prefix cache
+  version-invalidated, retains the displaced buffer for one-step
+  :meth:`~HotReloader.rollback`; :class:`ShadowABScheduler` mirrors a
+  deterministic traffic fraction onto a shadow engine serving
+  candidate weights and builds per-arm SLO reports for the promotion
+  decision.  Default off: a scheduler that never constructs these is
+  byte-for-byte unchanged.
 
 End-to-end recipe (the shape ``tests/test_serving.py`` drives)::
 
@@ -119,6 +132,7 @@ from apex_tpu.serving.loadgen import (
     OpenLoopWorkload,
     VirtualClock,
     burst_arrivals,
+    chain_hooks,
     make_workload,
     mixed_length_prompts,
     poisson_arrivals,
@@ -164,6 +178,14 @@ from apex_tpu.serving.scheduler import (
     RequestResult,
     SchedulerStalled,
 )
+from apex_tpu.serving.reload import (
+    ABConfig,
+    HotReloader,
+    ReloadOutcome,
+    ShadowABScheduler,
+    WeightWatcher,
+    assign_arm,
+)
 from apex_tpu.serving.weights import load_serving_params
 
 __all__ = [
@@ -207,6 +229,7 @@ __all__ = [
     "OpenLoopWorkload",
     "VirtualClock",
     "burst_arrivals",
+    "chain_hooks",
     "make_workload",
     "mixed_length_prompts",
     "poisson_arrivals",
@@ -214,4 +237,10 @@ __all__ = [
     "uniform_arrivals",
     "zero_overlap_prompts",
     "load_serving_params",
+    "ABConfig",
+    "HotReloader",
+    "ReloadOutcome",
+    "ShadowABScheduler",
+    "WeightWatcher",
+    "assign_arm",
 ]

@@ -353,6 +353,13 @@ class DecodeEngine:
             self._host_target = self._device
             self._cache_specs = None
             self._cache = jax.device_put(fresh, self._device)
+            # pin (commit) the params too: jit keys its executable
+            # cache on input placement, so an uncommitted boot tree
+            # followed by a committed checkpoint-restored swap
+            # candidate would retrace every program family once —
+            # the zero-compile hot-swap contract needs one placement
+            # signature from boot onward
+            self.params = jax.device_put(params, self._device)
         else:
             self._device = jax.local_devices()[0]
             P = PartitionSpec
@@ -395,6 +402,10 @@ class DecodeEngine:
         # decode hot path (dynamic_update_slice clamps out-of-range
         # indices silently — overflow must be an error, not corruption)
         self._lengths_host = np.zeros((self.slots,), np.int64)
+        # monotonic weight-buffer generation: bumped by swap_params so
+        # host layers (the prefix cache's version tags, the reloader's
+        # rollback bookkeeping) can tell which weights produced a byte
+        self._weights_version = 0
 
         def _prefill(params, cache, ids, slot, offset, length):
             # ids [1, B] (one bucket's shape — jit compiles one program
@@ -647,6 +658,69 @@ class DecodeEngine:
             for slot in range(self.slots):
                 self._pager.release(slot)
             self._flush_tables()
+
+    # ---- hot weight swap (serving/reload.py's engine surface) ------------
+    @property
+    def weights_version(self) -> int:
+        """Monotonic generation counter of the served weight buffer
+        (0 == the boot params; bumped by every :meth:`swap_params`,
+        including rollbacks)."""
+        return self._weights_version
+
+    def swap_params(self, params) -> Any:
+        """Replace the served params with ``params``; returns the old
+        buffer (the caller's rollback copy).
+
+        The replacement tree must match the current one exactly —
+        structure, leaf shapes, leaf dtypes — because every compiled
+        program family (prefill, decode, verify, restore, capture
+        read, CoW) takes ``params`` as a *traced* argument: a
+        same-spec tree re-dispatches the already-compiled executables
+        with **zero** new compiles, while a mismatched one would
+        silently retrace.  The check makes the retrace impossible, so
+        a validated-but-wrong candidate (e.g. a different model's
+        checkpoint that happens to restore) is refused here rather
+        than served.  KV cache, block tables, and per-slot lengths are
+        untouched: decode state is weight-independent, so in-flight
+        streams continue under the new weights with no drop.
+
+        Under tensor parallelism the new tree is laid out onto the tp
+        mesh exactly like ``__init__`` did (a no-op transfer when
+        ``weights.load_serving_params(shardings=...)`` already
+        restored it there).  The swap itself is a host pointer write —
+        the engine is between dispatches at every scheduler step
+        boundary, which is the only place a reloader calls this.
+        """
+        old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_def = jax.tree_util.tree_flatten(params)
+        if new_def != old_def:
+            raise ValueError(
+                f"swap_params: candidate tree structure does not match "
+                f"the served params ({new_def} != {old_def}) — the "
+                f"compiled programs would retrace; refuse the swap")
+        for i, (o, n) in enumerate(zip(old_leaves, new_leaves)):
+            if (tuple(o.shape) != tuple(n.shape)
+                    or jnp.dtype(o.dtype) != jnp.dtype(n.dtype)):
+                raise ValueError(
+                    f"swap_params: leaf {i} is "
+                    f"{tuple(n.shape)}/{jnp.dtype(n.dtype)} but the "
+                    f"served params have "
+                    f"{tuple(o.shape)}/{jnp.dtype(o.dtype)} — a "
+                    f"different model's weights cannot be hot-swapped")
+        if self._tp_cfg is not None:
+            # committed mesh placement, same as __init__ — a no-op
+            # when the restore already landed on these shardings
+            params = jax.device_put(
+                params, tp_param_shardings(params, self._mesh))
+        else:
+            # same committed single-device placement as __init__
+            # (zero-copy when already there): committed-vs-uncommitted
+            # is a jit cache key, and a placement flip would retrace
+            params = jax.device_put(params, self._device)
+        old = self.params
+        self.params = params
+        self._weights_version += 1
+        return old
 
     # ---- paged-cache state (no-ops / None on dense engines) --------------
     @property

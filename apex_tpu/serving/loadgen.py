@@ -65,6 +65,7 @@ __all__ = [
     "LoadGenerator",
     "LoadgenResult",
     "OpenLoopWorkload",
+    "chain_hooks",
     "VirtualClock",
     "burst_arrivals",
     "make_workload",
@@ -105,6 +106,29 @@ class VirtualClock:
 # ---------------------------------------------------------------------------
 # arrival processes — offset tables in seconds, deterministic by seed
 # ---------------------------------------------------------------------------
+
+def chain_hooks(*hooks):
+    """Compose ``step_hook`` callables into one, fired in order.
+
+    The reload acceptance runs stack hooks — a
+    :class:`~apex_tpu.resilience.fault_injection.SlowDecodeStep`
+    straggler, a
+    :class:`~apex_tpu.resilience.fault_injection.ReloadStorm`, a
+    mid-run corruption trigger — on a single
+    :class:`LoadGenerator`, which takes exactly one hook.  ``None``
+    entries are skipped so call sites can toggle hooks inline;
+    an all-``None`` chain returns ``None`` (no hook at all — the
+    loadgen's default-off path stays the default-off path)."""
+    live = [h for h in hooks if h is not None]
+    if not live:
+        return None
+
+    def hook(step: int, scheduler) -> None:
+        for h in live:
+            h(step, scheduler)
+
+    return hook
+
 
 def uniform_arrivals(n: int, rate_rps: float) -> Tuple[float, ...]:
     """``n`` arrivals equally spaced at ``rate_rps`` requests/s,

@@ -128,6 +128,7 @@ class _Entry:
     lo: int = 0                  # this block's row offset inside span
     refs: int = 0                # live pins; > 0 == never evictable
     block_id: Optional[int] = None  # pool block id (paged mode)
+    version: int = 0             # weights generation the K/V came from
 
 
 class PrefixCache:
@@ -173,6 +174,11 @@ class PrefixCache:
         self._inserted = 0
         self._evicted = 0
         self._refused = 0
+        # current weights generation: entries are stamped at insert and
+        # only same-version entries match — cached K/V captured under
+        # old weights must never resume a new-weights stream (the hot
+        # reload invalidation contract; see bump_version)
+        self._version = 0
 
     # ---- hashing ---------------------------------------------------------
     @staticmethod
@@ -221,7 +227,46 @@ class PrefixCache:
                 "cached_bytes": self.cached_bytes,
                 "hits": self._hits, "misses": self._misses,
                 "inserted": self._inserted, "evicted": self._evicted,
-                "refused": self._refused}
+                "refused": self._refused,
+                "version": self._version,
+                "stale_entries": self.stale_entries}
+
+    @property
+    def version(self) -> int:
+        """Current weights generation new inserts are stamped with."""
+        return self._version
+
+    @property
+    def stale_entries(self) -> int:
+        """Entries surviving from an older weights generation — pinned
+        by (or mid-chain under) streams admitted before a swap.  They
+        are unmatchable and un-extendable; LRU eviction reclaims them
+        as their pins release."""
+        return sum(1 for e in self._entries.values()
+                   if e.version != self._version)
+
+    def bump_version(self) -> int:
+        """Invalidate every cached entry for a weight swap: the store's
+        version advances, so existing entries stop matching (old-weights
+        K/V must never resume a new-weights stream) and droppable ones
+        are reclaimed immediately.  Entries pinned by live slots — a
+        pre-swap stream still mid-prompt — survive *as storage* (their
+        stream's own restore already happened and its decode state is
+        self-consistent) but can never feed a new admission; they drop
+        when their pins release and eviction reaches them.  Returns the
+        new version."""
+        self._version += 1
+        # fixpoint sweep, leaves first: a stale parent becomes droppable
+        # once its stale children are gone
+        while True:
+            victim = next(
+                (e for e in self._entries.values()
+                 if e.version != self._version and self._evictable(e)),
+                None)
+            if victim is None:
+                break
+            self._drop(victim)
+        return self._version
 
     # ---- lookup ----------------------------------------------------------
     def _touch(self, entry: _Entry) -> None:
@@ -242,7 +287,11 @@ class PrefixCache:
         while pos + self.block_size <= n - 1:
             h = self.chain_hash(h, prompt[pos:pos + self.block_size])
             entry = self._entries.get(h)
-            if entry is None:
+            if entry is None or entry.version != self._version:
+                # a stale-version entry is K/V from pre-swap weights:
+                # restoring it would resume a new-weights stream from
+                # old-weights bytes — treated as absent (and left
+                # untouched, so LRU eviction reclaims it first)
                 break
             out.append(entry)
             pos += self.block_size
@@ -259,8 +308,9 @@ class PrefixCache:
         the cheap presence probe capture uses to skip the device read
         for a block another stream already inserted."""
         entry = self._entries.get(chain)
-        if entry is not None:
-            self._touch(entry)
+        if entry is None or entry.version != self._version:
+            return None          # stale == absent (see match)
+        self._touch(entry)
         return entry
 
     # ---- pinning ---------------------------------------------------------
@@ -279,6 +329,27 @@ class PrefixCache:
             entry.refs -= 1
 
     # ---- insert + eviction -----------------------------------------------
+    def _insert_site(self, chain: str) -> Tuple[Optional[_Entry], bool]:
+        """Resolve ``chain`` for an insert: ``(live entry, blocked)``.
+        A current-version entry is the idempotent-reinsert case; a
+        stale-version one is replaced when droppable, else the insert
+        is BLOCKED (a pinned/mid-chain stale entry cannot be dropped,
+        and chaining fresh K/V onto it would make the new entry
+        reachable only through an unmatchable parent)."""
+        entry = self._entries.get(chain)
+        if entry is None or entry.version == self._version:
+            return entry, False
+        if self._evictable(entry):
+            self._drop(entry)
+            return None, False
+        return None, True
+
+    def _parent_live(self, parent: str) -> bool:
+        if parent == _ROOT:
+            return True
+        entry = self._entries.get(parent)
+        return entry is not None and entry.version == self._version
+
     def put(self, parent: str, tokens: Sequence[int], k, v
             ) -> Optional[_Entry]:
         """Insert one captured block (its own single-block span) — the
@@ -318,16 +389,19 @@ class PrefixCache:
                     f"{self.block_size} — only whole blocks are "
                     f"hashable")
             chain = self.chain_hash(parent, tokens)
-            entry = self._entries.get(chain)
+            entry, blocked = self._insert_site(chain)
+            if blocked:
+                self._refused += 1
+                break
             if entry is None:
-                if parent != _ROOT and parent not in self._entries:
+                if not self._parent_live(parent):
                     self._refused += 1
                     logger.debug("prefix put refused: parent %.12s "
                                  "evicted", parent)
                     break
                 self._pool.ref([int(bid)])
                 entry = _Entry(chain=chain, parent=parent, tokens=tokens,
-                               block_id=int(bid))
+                               block_id=int(bid), version=self._version)
                 self._entries[chain] = entry
                 self._children.setdefault(parent, set()).add(chain)
                 self._inserted += 1
@@ -386,15 +460,19 @@ class PrefixCache:
                     f"{self.block_size} — only whole blocks are "
                     f"hashable")
             chain = self.chain_hash(parent, tokens)
-            entry = self._entries.get(chain)
+            entry, blocked = self._insert_site(chain)
+            if blocked:
+                self._refused += 1
+                break
             if entry is None:
-                if parent != _ROOT and parent not in self._entries:
+                if not self._parent_live(parent):
                     self._refused += 1
                     logger.debug("prefix put refused: parent %.12s "
                                  "evicted", parent)
                     break
                 entry = _Entry(chain=chain, parent=parent, tokens=tokens,
-                               span=span, lo=i * self.block_size)
+                               span=span, lo=i * self.block_size,
+                               version=self._version)
                 self._entries[chain] = entry
                 self._children.setdefault(parent, set()).add(chain)
                 if span.live == 0:

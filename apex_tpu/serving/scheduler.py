@@ -215,6 +215,7 @@ class _Active:
     blocks_cached: int = 0
     pinned: List = dataclasses.field(default_factory=list)
     preemptions: int = 0     # lossless suspend/resume cycles survived
+    wv: int = 0              # engine weights_version at admission
 
     @property
     def prompt_remaining(self) -> int:
@@ -533,7 +534,8 @@ class ContinuousBatchingScheduler:
         st = _Active(request=request, slot=slot, seq=self._admit_seq,
                      base_key=np.asarray(request_key(request.seed)),
                      tokens=[], t_submit=t_submit, t_first=0.0,
-                     draft_k=draft_k)
+                     draft_k=draft_k,
+                     wv=int(getattr(self.engine, "weights_version", 0)))
         self._admit_seq += 1
         self._active[slot] = st
         logger.debug("admitted %s into slot %d (queue %d deep)",
@@ -944,6 +946,30 @@ class ContinuousBatchingScheduler:
                 # BlockPoolExhausted despite reclaimable blocks
                 self.engine.set_block_reclaim(None)
 
+    def swap_weights(self, params) -> object:
+        """Hot-swap the engine's served weights at this step boundary;
+        returns the displaced buffer (the caller's rollback copy).
+
+        Call between :meth:`step` calls only (the scheduler is a single
+        host loop, so "between steps" is any point a driver or loadgen
+        ``step_hook`` runs).  The swap is a host pointer write — every
+        compiled program family re-dispatches unchanged under the new
+        tree (:meth:`DecodeEngine.swap_params` enforces the same-spec
+        contract that makes that true) — and in-flight streams are
+        PRESERVED: decode state (KV cache, block tables, lengths,
+        sampler keys) is weight-independent, so active slots simply
+        continue under the new weights, token streams intact.  The
+        prefix cache is version-bumped so no cached pre-swap K/V can
+        ever feed a post-swap admission; streams admitted pre-swap
+        stop offering their (now hybrid) blocks.  The FIFO/default
+        path with no swap ever requested is byte-for-byte untouched —
+        this method is the ONLY reload surface the scheduler grows.
+        """
+        old = self.engine.swap_params(params)
+        if self._prefix is not None:
+            self._prefix.bump_version()
+        return old
+
     def _match_and_restore(self, st: _Active) -> None:
         """Admission-time prefix reuse: longest-chain match against the
         prompt, bucketed restore of the hit into the fresh slot, and a
@@ -1000,6 +1026,15 @@ class ContinuousBatchingScheduler:
         blocks of this chunk — always a contiguous tail, because a
         chain hash cannot exist without its parent — are snapshotted
         in ONE batched region read and sliced per block."""
+        if st.wv != int(getattr(self.engine, "weights_version", 0)):
+            # a stream admitted before a hot weight swap: its remaining
+            # prefill rows are computed under the NEW weights but attend
+            # over pre-swap cached context — self-consistent for the
+            # stream itself, but the hybrid K/V must never be offered to
+            # the cache (chain hashes are pure token hashes, so a fresh
+            # same-prompt admission would restore these bytes as if they
+            # were clean new-weights prefill output)
+            return
         block = self._prefix.block_size
         total = st.prompt_pos // block     # complete blocks available
         # 1) advance over blocks another stream already inserted

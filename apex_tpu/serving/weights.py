@@ -73,8 +73,16 @@ def _restore_newest_valid(root: str, like: Any, step: Optional[int]
     (one implementation for the host and mesh restore paths).  Returns
     ``(tree, step, manifest, sharded, step_dir)``; raises
     :class:`CheckpointError` when nothing under ``root`` restores."""
-    candidates = ([step] if step is not None
-                  else list(reversed(_ckpt._list_steps(root))))
+    if step is not None:
+        candidates = [step]
+    else:
+        # honor the live-writer registry: a step an in-process
+        # AsyncCheckpointer is mid-commit on (a re-save swaps the old
+        # dir aside before the new one lands) must never be selected —
+        # the watcher/reloader reads whatever was last COMMITTED
+        live = _ckpt.in_flight_steps(root)
+        candidates = [s for s in reversed(_ckpt._list_steps(root))
+                      if s not in live]
     if not candidates:
         raise CheckpointError(f"no checkpoints under {root!r}")
     errors: list[str] = []
@@ -155,8 +163,17 @@ def load_serving_params(root: str, like: Any, *,
                 f"subtree to serve from") from e
     if policy is not None:
         tree = policy.cast_params(tree)
+    import jax
+
+    nbytes = sum(int(getattr(leaf, "nbytes", 0))
+                 for leaf in jax.tree.leaves(tree))
+    # step + format + bytes + wall time: the reload observability
+    # contract — the obs bridge sets apex_serving_weights_step and
+    # observes the restore phase of
+    # apex_serving_reload_duration_seconds from exactly this event
     emit_event("serving_weights_loaded", step=int(got),
                format_version=int(manifest.get("format_version", 1)),
                sharded=sharded, params_key=params_key,
-               opt_level=getattr(policy, "opt_level", None), t0=t0)
+               opt_level=getattr(policy, "opt_level", None),
+               bytes=nbytes, t0=t0)
     return tree, got

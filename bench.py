@@ -1502,6 +1502,189 @@ def _serving_slo_metrics(*, n_requests: int = 24, prompt_len: int = 48,
     }
 
 
+def _serving_reload_metrics(*, n_requests: int = 16, prompt_len: int = 48,
+                            new_tokens: int = 12, prefill_len: int = 64,
+                            max_len: int = 128, slots: int = 4,
+                            burst: int = 4, seed: int = 11,
+                            reload_at_step: int = 4,
+                            ab_fraction: float = 0.25,
+                            ab_period_s: float = 0.5) -> dict:
+    """Hot weight reload + shadow/A-B cost (the BENCH_*.json
+    ``serving_reload`` block, ISSUE 16).
+
+    Protocol: (1) a steady all-at-once burst run over a warmed engine
+    records per-step wall times — back-to-back arrivals so every wall
+    is compute, not arrival pacing — the no-reload baseline; (2) the
+    SAME workload runs again with a :class:`HotReloader` restoring a
+    freshly committed checkpoint and swapping mid-drain at a step
+    boundary — ``swap_pause_ms`` is the p99 per-step inflation of that
+    run over the steady run (the honest "what does a stream feel"
+    number: this reloader restores synchronously inside the step hook,
+    so the pause includes the checkpoint read, not just the pointer
+    swap — the per-phase split is also recorded), ``dropped_streams``
+    must be 0, and the warmed decode program must not recompile across
+    the swap; (3) a *paced* open-loop run (bursts every
+    ``ab_period_s`` — the capacity-headroom regime shadow traffic is
+    deployed in) runs unmirrored vs mirrored
+    (:class:`ShadowABScheduler`, ``ab_fraction`` of requests copied to
+    a second warmed engine) — ``ab.ab_mirror_overhead_ratio`` is the
+    wall-clock multiplier shadow service costs the incumbent.  Both
+    engines share this host thread, so the same comparison is repeated
+    with back-to-back arrivals as ``ab.saturated_overhead_ratio``: the
+    no-headroom worst case where every shadow step displaces an
+    incumbent step (in deployment the shadow arm is its own replica
+    and that serialization does not exist)."""
+    import math
+    import shutil
+    import tempfile
+
+    from apex_tpu import resilience as rz
+    from apex_tpu.serving import (ABConfig, ContinuousBatchingScheduler,
+                                  HotReloader, LoadGenerator,
+                                  ShadowABScheduler, burst_arrivals,
+                                  default_prefill_buckets, make_workload,
+                                  zero_overlap_prompts)
+
+    cfg, model, params = _serving_bench_setup(max_len=max_len)
+    # warm every prefill bucket (the slo block's lesson: budget
+    # fragmentation lands sub-bucket chunks, and a compile inside a
+    # timed window would masquerade as reload/mirror cost)
+    warm_lens = [prompt_len] + list(default_prefill_buckets(prefill_len))
+    eng, _ = _warm_serving_pair(
+        model, params, slots=slots, max_len=max_len,
+        prefill_len=prefill_len, warm_lens=warm_lens,
+        warm_prompt_len=min(prompt_len, max_len - 2))
+    prompts = zero_overlap_prompts(n_requests, length=prompt_len,
+                                   vocab=cfg.vocab_size, seed=seed)
+
+    def workload(period_s=0.0):
+        arrivals = ((0.0,) * n_requests if period_s <= 0 else
+                    burst_arrivals(n_requests, burst=burst,
+                                   period_s=period_s))
+        return make_workload(prompts, arrivals,
+                             max_new_tokens=new_tokens,
+                             rid_prefix="rl", seed=seed)
+
+    def timed_run(sched, extra_hook=None):
+        walls = []
+        last = [time.perf_counter()]
+
+        def hook(step, s):
+            now = time.perf_counter()
+            walls.append(now - last[0])
+            last[0] = now          # NOT re-read after extra_hook: the
+            # reload runs inside the hook, and its cost must land in
+            # the next step's wall — that pause is what a live stream
+            # actually waits through
+            if extra_hook is not None:
+                extra_hook(step, s)
+
+        out = LoadGenerator(sched, workload(), step_hook=hook).run()
+        return out, walls
+
+    def p99(xs):
+        return sorted(xs)[max(0, int(math.ceil(0.99 * len(xs))) - 1)]
+
+    # 1) steady baseline
+    sched = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                        log_interval=10 ** 9)
+    steady_out, steady_walls = timed_run(sched)
+
+    # 2) the reload run: a committed candidate swaps in mid-drain
+    root = tempfile.mkdtemp(prefix="apex_reload_bench_")
+    try:
+        rz.save_checkpoint(root, 200, {
+            "params": jax.tree.map(
+                lambda l: l + 0.01 if jnp.issubdtype(l.dtype,
+                                                     jnp.floating)
+                else l, params)})
+        sched = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                            log_interval=10 ** 9)
+        reloader = HotReloader(sched, root, like={"params": params},
+                               params_key="params", current_step=100)
+        outcomes = []
+
+        def reload_hook(step, s):
+            if step == reload_at_step:
+                outcomes.append(reloader.reload(step=200))
+
+        decode_compiles_before = eng.decode_compiles()
+        reload_out, reload_walls = timed_run(sched, reload_hook)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    assert outcomes and outcomes[0].ok, "bench reload refused"
+    assert eng.decode_compiles() == decode_compiles_before, \
+        "the hot swap must not compile a new decode program"
+    dropped = (reload_out.offered - reload_out.completed
+               - len(reload_out.rejected))
+
+    # 3) A/B mirror overhead: unmirrored vs mirrored wall clock.  The
+    # shadow engine is warmed separately first — its one-time compiles
+    # are a boot cost, not a per-request mirror tax.
+    shadow_eng, _ = _warm_serving_pair(
+        model, params, slots=slots, max_len=max_len,
+        prefill_len=prefill_len, warm_lens=warm_lens,
+        warm_prompt_len=min(prompt_len, max_len - 2))
+
+    def ab_compare(period_s):
+        sched = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                            log_interval=10 ** 9)
+        t0 = time.perf_counter()
+        un_out = LoadGenerator(sched, workload(period_s)).run()
+        un_s = time.perf_counter() - t0
+        primary = ContinuousBatchingScheduler(eng, max_queue=n_requests,
+                                              log_interval=10 ** 9)
+        shadow = ContinuousBatchingScheduler(shadow_eng,
+                                             max_queue=n_requests,
+                                             log_interval=10 ** 9)
+        ab = ShadowABScheduler(primary, shadow,
+                               ABConfig(fraction=ab_fraction,
+                                        seed=seed))
+        t0 = time.perf_counter()
+        ab_out = LoadGenerator(ab, workload(period_s)).run()
+        mir_s = time.perf_counter() - t0
+        assert un_out.completed == ab_out.completed, \
+            "mirroring changed incumbent completion"
+        return un_s, mir_s, ab
+
+    unmirrored_s, mirrored_s, ab = ab_compare(ab_period_s)
+    sat_un_s, sat_mir_s, _ = ab_compare(0.0)
+
+    o = outcomes[0]
+    return {
+        "ok": True,
+        "reload_wall_s": round(o.restore_s + o.validate_s + o.swap_s, 4),
+        "restore_s": round(o.restore_s, 4),
+        "validate_s": round(o.validate_s, 4),
+        "swap_s": round(o.swap_s, 4),
+        "steady_step_ms_p99": round(p99(steady_walls) * 1e3, 3),
+        "reload_step_ms_p99": round(p99(reload_walls) * 1e3, 3),
+        "swap_pause_ms": round(
+            max(0.0, p99(reload_walls) - p99(steady_walls)) * 1e3, 3),
+        "dropped_streams": dropped,
+        "completed": reload_out.completed,
+        "shed": len(reload_out.rejected),
+        "ab": {
+            "unmirrored_wall_s": round(unmirrored_s, 4),
+            "mirrored_wall_s": round(mirrored_s, 4),
+            "ab_mirror_overhead_ratio": round(
+                mirrored_s / max(unmirrored_s, 1e-9), 4),
+            "saturated_overhead_ratio": round(
+                sat_mir_s / max(sat_un_s, 1e-9), 4),
+            "mirrored_requests": len(ab.mirrored_rids),
+            "mirror_shed": ab.mirror_shed,
+        },
+        "decode_compiles": eng.decode_compiles(),
+        "prefill_compiles": eng.prefill_compiles(),
+        "config": {"n_requests": n_requests, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "slots": slots,
+                   "max_len": max_len, "prefill_len": prefill_len,
+                   "reload_at_step": reload_at_step,
+                   "ab_fraction": ab_fraction,
+                   "ab_period_s": ab_period_s, "seed": seed},
+    }
+
+
 def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
     """Observability tax of the ISSUE-6 layer (the BENCH_*.json ``obs``
     block): per-update cost of each instrument kind, span enter/exit
@@ -1758,6 +1941,11 @@ def run_config(name: str, *, batch: int | None = None,
         serving_slo = {"ok": False,
                        "error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        serving_reload = _serving_reload_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        serving_reload = {"ok": False,
+                          "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         obs = _obs_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
@@ -1781,6 +1969,7 @@ def run_config(name: str, *, batch: int | None = None,
         "serving_prefix": serving_prefix,
         "serving_paged": serving_paged,
         "serving_slo": serving_slo,
+        "serving_reload": serving_reload,
         "obs": obs,
         "config": out_cfg,
     }

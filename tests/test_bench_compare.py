@@ -30,6 +30,15 @@ GOLDEN_OLD = {
         "loads": {"2x": {"ttft_s": {"p99": 0.10, "n": 24},
                          "goodput": 0.8}},
     },
+    "serving_reload": {
+        "ok": True,
+        "reload_wall_s": 0.5,
+        "swap_pause_ms": 2.0,
+        "dropped_streams": 0,
+        "ab_mirror_overhead_ratio": 1.05,
+        "decode_compiles_after_warmup": 1,
+        "config": {"reload_at_step": 4},
+    },
 }
 
 
@@ -62,6 +71,17 @@ class TestClassify:
         assert bc.classify("slo.queue_wait_s.p95") == "lower"
         assert bc.classify("serving.decode_compiles_after_warmup") == "exact"
         assert bc.classify("serving.ok") == "exact_higher"
+
+    def test_reload_family_direction_aware(self):
+        base = "serving_reload"
+        assert bc.classify(f"{base}.ok") == "exact_higher"
+        assert bc.classify(f"{base}.swap_pause_ms") == "lower"
+        assert bc.classify(f"{base}.reload_wall_s") == "lower"
+        assert bc.classify(f"{base}.dropped_streams") == "lower"
+        assert bc.classify(f"{base}.ab_mirror_overhead_ratio") == "lower"
+        assert bc.classify(
+            f"{base}.decode_compiles_after_warmup") == "exact"
+        assert bc.classify(f"{base}.config.reload_at_step") is None
 
     def test_informational(self):
         assert bc.classify("serving.config.slots") is None
@@ -149,6 +169,20 @@ class TestCompare:
         new = _mutated(**{"serving.ok": False})
         kinds = _kinds(bc.compare(GOLDEN_OLD, new))
         assert kinds["serving.ok"] == "regression"
+
+    def test_reload_regressions_flagged(self):
+        worse = _mutated(**{"serving_reload.swap_pause_ms": 4.0,
+                            "serving_reload.dropped_streams": 1,
+                            "serving_reload.ab_mirror_overhead_ratio": 1.4})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        assert kinds["serving_reload.swap_pause_ms"] == "regression"
+        # zero-baseline: ANY dropped stream is outside tolerance
+        assert kinds["serving_reload.dropped_streams"] == "regression"
+        assert (kinds["serving_reload.ab_mirror_overhead_ratio"]
+                == "regression")
+        flip = _mutated(**{"serving_reload.ok": False})
+        assert _kinds(bc.compare(GOLDEN_OLD, flip))[
+            "serving_reload.ok"] == "regression"
 
     def test_missing_graded_metric_flagged(self):
         new = json.loads(json.dumps(GOLDEN_OLD))

@@ -9,6 +9,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 
 # extend (not replace) the environment: a from-scratch dict hardcodes
@@ -21,6 +23,8 @@ ENV = {**os.environ,
        "PYTHONPATH": str(REPO)}
 
 
+@pytest.mark.slow   # ~21 s: two-optimizer fp16 scaling keeps tier-1
+# witnesses in test_amp.py; the dcgan driver itself is smoke-only
 def test_dcgan_amp_two_optimizers():
     out = subprocess.run(
         [sys.executable, str(REPO / "examples" / "dcgan" / "main_amp.py"),

@@ -83,6 +83,10 @@ def _prompt(seed=0, n=5):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow   # ~58 s: tier-1 keeps cheaper witnesses of the same
+# cached==uncached claim (test_long_prompt_chunked_prefill_bit_identical
+# plus both checkpoint-loads-and-serves tests, all asserting decode
+# output against full_fwd)
 def test_greedy_decode_bit_identical_to_uncached(model, params, full_fwd):
     # prefill_len == max_len: prefill shares the decode steps' reduction
     # extents, so the whole stream (first token included) is bit-exact

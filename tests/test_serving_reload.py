@@ -1,0 +1,1018 @@
+"""Online serving ops: hot weight reload, rollback, shadow/A-B (ISSUE 16).
+
+THE acceptance run: while an ``AsyncCheckpointer`` publishes new steps,
+a scheduler drains a bursty open-loop workload on a virtual clock and
+hot-reloads mid-stream — **zero dropped streams**, post-swap tokens
+**bit-identical** to a fresh engine booted on the new weights and fed
+the same state, a corrupted candidate refused with the old weights
+served bit-exactly, and ``rollback()`` bit-exact — on dense and paged
+engines, tp=1 and tp=2.
+
+Plus: the watcher/writer race (a re-save swaps the committed dir aside
+mid-commit; ``latest_valid_step`` and the serving-side walk must skip
+live-writer steps, never crash, never select a partial dir), boot-time
+degraded start (newest corrupt → fallback, later hot reload picks up
+the repaired step), prefix-cache version invalidation across a swap,
+seed-deterministic shadow/A-B with per-arm SLO reports that reconcile
+against the request-trace recorder, and the house default-off rules:
+byte-for-byte identity when nothing reload-shaped is constructed, and
+zero new compiles per program family across a swap.
+"""
+
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import _logging
+from apex_tpu import resilience as rz
+from apex_tpu import serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+from apex_tpu.obs import bridge as obs_bridge
+from apex_tpu.obs import request_trace as rt
+from apex_tpu.resilience import checkpoint as _ckpt
+from apex_tpu.resilience.fault_injection import (
+    CrashCheckpointWriter,
+    FaultInjector,
+    FaultPlan,
+    ReloadStorm,
+)
+from apex_tpu.serving.engine import TPConfig
+from apex_tpu.serving.paged_kv_cache import PagedCacheConfig
+from apex_tpu.serving.prefix_cache import PrefixCacheConfig
+from apex_tpu.utils.compat import device_count_skip_reason, devices_available
+
+# GQA on purpose, like test_serving.py: kv_heads (2) < heads (4)
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256)
+MAX = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def params_v2(params):
+    """A genuinely different weight version (greedy argmaxes move)."""
+    return _mutated(params, 0.05)
+
+
+def _mutated(tree, delta):
+    return jax.tree.map(
+        lambda l: l + delta if jnp.issubdtype(l.dtype, jnp.floating)
+        else l, tree)
+
+
+def _prompt(seed=0, n=10):
+    rng = np.random.default_rng(seed)
+    return [int(x) for x in rng.integers(1, CFG.vocab_size, n)]
+
+
+def _tree_bytes_equal(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(fa, fb))
+
+
+def _save_versions(root, params, *steps):
+    """Commit train-state checkpoints {params: params + step/1000}."""
+    for s in steps:
+        rz.save_checkpoint(str(root), s,
+                           {"params": _mutated(params, s / 1000.0)})
+
+
+class _EventTap:
+    def __init__(self):
+        self.events = []
+
+    def __enter__(self):
+        self._sink = lambda e: self.events.append(dict(e))
+        _logging.add_event_sink(self._sink)
+        return self
+
+    def __exit__(self, *exc):
+        _logging.remove_event_sink(self._sink)
+
+    def of(self, kind):
+        return [e for e in self.events if e.get("event") == kind]
+
+
+def _engine(model, params, *, paged=False, tp=None, slots=4):
+    kw = {}
+    if paged:
+        kw["paged"] = PagedCacheConfig(block_size=16, num_blocks=64)
+    if tp is not None:
+        kw["tp"] = TPConfig(size=tp)
+    return sv.DecodeEngine(model, params, slots=slots, max_len=MAX,
+                           prefill_len=16, **kw)
+
+
+def _workload(n=6, burst=3, seed=0, max_new=8):
+    return sv.make_workload(
+        sv.zero_overlap_prompts(n, length=8, vocab=CFG.vocab_size,
+                                seed=seed),
+        sv.burst_arrivals(n, burst=burst, period_s=0.5),
+        max_new_tokens=max_new)
+
+
+# ---------------------------------------------------------------------------
+# engine-level swap: the bit-identity core
+# ---------------------------------------------------------------------------
+
+
+class TestEngineSwap:
+    def test_post_swap_tokens_bit_identical_to_fresh_engine_same_state(
+            self, model, params, params_v2):
+        """THE core claim: decode k tokens on old weights, swap, decode
+        m more — the post-swap tokens (and logits, byte for byte) equal
+        a FRESH engine booted on the new weights and fed the captured
+        state.  Decode state is weight-independent; the swap touches
+        nothing else."""
+        eng = _engine(model, params, slots=2)
+        prompt = _prompt(seed=1)
+        logits = eng.prefill(0, prompt)
+        stream = [int(jnp.argmax(logits))]
+        toks = np.zeros((eng.slots,), np.int32)
+        act = np.zeros((eng.slots,), bool)
+        act[0] = True
+        for _ in range(4):                       # old-weights tokens
+            toks[0] = stream[-1]
+            stream.append(int(jnp.argmax(eng.decode(toks, act)[0])))
+        k, v, length = eng.capture_slot(0)       # the state at the swap
+
+        old = eng.swap_params(params_v2)
+        assert eng.weights_version == 1
+        assert _tree_bytes_equal(old, params)    # displaced buffer intact
+        post, post_logits = [], []
+        for _ in range(6):                       # new-weights tokens
+            toks[0] = (post[-1] if post else stream[-1])
+            lg = eng.decode(toks, act)[0]
+            post_logits.append(np.asarray(lg))
+            post.append(int(jnp.argmax(lg)))
+
+        fresh = _engine(model, params_v2, slots=2)
+        fresh.restore_prefix(0, (k, v), length)
+        ref, ref_logits = [], []
+        for _ in range(6):
+            toks[0] = (ref[-1] if ref else stream[-1])
+            lg = fresh.decode(toks, act)[0]
+            ref_logits.append(np.asarray(lg))
+            ref.append(int(jnp.argmax(lg)))
+        assert post == ref
+        for a, b in zip(post_logits, ref_logits):
+            np.testing.assert_array_equal(a, b)
+        # and the streams actually changed across versions — the swap
+        # did something (params_v2 is a real different model)
+        assert eng.weights_version == 1
+
+    def test_swap_is_zero_new_compiles_per_family(self, model, params,
+                                                  params_v2, tmp_path):
+        eng = _engine(model, params, slots=2)
+        prompt = _prompt(seed=2)
+        eng.prefill(0, prompt)
+        toks = np.zeros((eng.slots,), np.int32)
+        act = np.zeros((eng.slots,), bool)
+        act[0] = True
+        eng.decode(toks, act)
+        pre_prefill = eng.prefill_compiles()
+        assert eng.decode_compiles() == 1
+        eng.swap_params(params_v2)
+        eng.decode(toks, act)                    # same program, new tree
+        eng.prefill(1, _prompt(seed=3))
+        assert eng.decode_compiles() == 1        # THE zero-compile swap
+        assert eng.prefill_compiles() == pre_prefill
+        # the provenance that actually bites: the engine booted on
+        # model.init params (uncommitted placement) and the candidate
+        # came through the checkpoint-restore path (device_put =
+        # committed placement).  jit keys its executable cache on
+        # placement, so without the engine pinning params at boot this
+        # swap retraced every program family once.
+        _save_versions(tmp_path, params, 7)
+        restored, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params")
+        eng.swap_params(restored)
+        eng.decode(toks, act)
+        eng.release(1)
+        eng.prefill(1, _prompt(seed=3))
+        assert eng.decode_compiles() == 1
+        assert eng.prefill_compiles() == pre_prefill
+
+    def test_swap_rejects_mismatched_candidate(self, model, params):
+        eng = _engine(model, params, slots=2)
+        wrong_shape = jax.tree.map(
+            lambda l: jnp.zeros(l.shape + (1,), l.dtype)
+            if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
+        with pytest.raises(ValueError):
+            eng.swap_params(wrong_shape)
+        wrong_dtype = jax.tree.map(
+            lambda l: l.astype(jnp.float16)
+            if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
+        with pytest.raises(ValueError):
+            eng.swap_params(wrong_dtype)
+        with pytest.raises(ValueError):
+            eng.swap_params({"nope": 1})
+        assert eng.weights_version == 0          # nothing swapped
+
+
+# ---------------------------------------------------------------------------
+# WeightWatcher: the three committed-step sources
+# ---------------------------------------------------------------------------
+
+
+class TestWeightWatcher:
+    def test_root_walk_source_and_monotonic_poll(self, params, tmp_path):
+        w = sv.WeightWatcher(str(tmp_path))
+        assert w.poll() is None                  # empty root: nothing
+        _save_versions(tmp_path, params, 3, 7)
+        assert w.poll() == 7                     # newest committed
+        w.mark(7)
+        assert w.poll() is None                  # nothing newer
+        _save_versions(tmp_path, params, 9)
+        assert w.poll() == 9
+        # a refused candidate is re-offered: mark() was never called
+        assert w.poll() == 9
+
+    def test_checkpointer_source(self, params, tmp_path):
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(str(tmp_path)))
+        w = sv.WeightWatcher(str(tmp_path), checkpointer=ac)
+        assert w.poll() is None                  # nothing committed yet
+        fut = ac.save(12, {"params": params})
+        fut.result()
+        assert ac.committed_step == 12           # the new surface
+        assert w.poll() == 12
+        w.mark(12)
+        assert w.poll() is None
+
+    def test_heartbeat_source(self, params, tmp_path):
+        hb = str(tmp_path / "heartbeat")
+        root = str(tmp_path / "ckpts")
+        _save_versions(root, params, 5)
+        w = sv.WeightWatcher(root, heartbeat_path=hb)
+        assert w.poll() is None                  # no heartbeat yet: no-op
+        ckpt_path = os.path.join(root, _ckpt._step_dirname(5))
+        rz.write_heartbeat(hb, 5, ckpt_path=ckpt_path)
+        assert w.poll() == 5
+        # heartbeat with no ckpt_path (training hasn't committed yet)
+        rz.write_heartbeat(hb, 6)
+        w2 = sv.WeightWatcher(root, heartbeat_path=hb)
+        assert w2.poll() is None
+
+    def test_one_source_only(self, tmp_path):
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(str(tmp_path)))
+        with pytest.raises(ValueError):
+            sv.WeightWatcher(str(tmp_path), heartbeat_path="x",
+                             checkpointer=ac)
+
+    def test_walk_skips_live_writer_steps(self, params, tmp_path):
+        """The registry contract: a step a live writer is mid-commit on
+        is invisible to the watcher (and to latest_valid_step)."""
+        _save_versions(tmp_path, params, 1, 4)
+        w = sv.WeightWatcher(str(tmp_path))
+        with _ckpt._live_writer(str(tmp_path), 4):
+            assert _ckpt.in_flight_steps(str(tmp_path)) == {4}
+            assert w.poll() == 1                 # 4 is mid-commit
+            assert rz.latest_valid_step(str(tmp_path)) == 1
+        assert w.poll() == 4                     # committed now
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the reload/writer race, concurrently
+# ---------------------------------------------------------------------------
+
+
+class TestWatcherWriterRace:
+    def test_concurrent_resave_never_crashes_or_selects_partial(
+            self, params, tmp_path):
+        """A re-save of a committed step renames the final dir aside
+        before installing the new one — a pre-fix reader validating
+        that dir mid-swap crashed on FileNotFoundError.  Hammer
+        latest_valid_step + the watcher against a loop of re-saves:
+        no exception, and every answer is a step that was durably
+        committed at some point (1 or 5), never a torn read."""
+        root = str(tmp_path)
+        mgr = rz.CheckpointManager(root, keep=8)
+        mgr.save(1, {"params": params})
+        mgr.save(5, {"params": params})
+        stop = threading.Event()
+        writer_err = []
+
+        def writer():
+            try:
+                while not stop.is_set():
+                    mgr.save(5, {"params": params})   # aside-swap path
+            except BaseException as e:               # pragma: no cover
+                writer_err.append(e)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            w = sv.WeightWatcher(root)
+            for _ in range(300):
+                got = rz.latest_valid_step(root)
+                assert got in (1, 5)
+                seen = w.committed_step()
+                assert seen in (1, 5)
+        finally:
+            stop.set()
+            t.join(30.0)
+        assert not writer_err
+
+    def test_writer_crash_leaves_watcher_blind_to_partial(
+            self, params, tmp_path):
+        """SimulatedWriterCrash racing the watcher: a writer killed
+        mid-write leaves only a temp dir — the watcher (and the serving
+        restore walk) must never see the step."""
+        root = str(tmp_path)
+        _save_versions(tmp_path, params, 2)
+        crash = CrashCheckpointWriter(after_records=1)
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(root),
+                                  progress_hook=crash)
+        fut = ac.save(6, {"params": params})
+        fut.join()
+        assert isinstance(fut.error, rz.SimulatedWriterCrash)
+        w = sv.WeightWatcher(root)
+        assert w.poll() == 2                     # 6 never committed
+        assert rz.latest_valid_step(root) == 2
+        got, step = sv.load_serving_params(root, {"params": params},
+                                           params_key="params")
+        assert step == 2
+        ac2 = rz.AsyncCheckpointer(rz.CheckpointManager(root))
+        ac2.save(6, {"params": params}).result()  # retry commits
+        assert w.poll() == 6
+
+
+# ---------------------------------------------------------------------------
+# HotReloader: validate gate, refusal, rollback
+# ---------------------------------------------------------------------------
+
+
+def _sched(engine, clk=None, **kw):
+    return sv.ContinuousBatchingScheduler(
+        engine, max_queue=16, clock=clk or sv.VirtualClock(), **kw)
+
+
+class TestHotReloader:
+    def test_reload_swaps_and_events_carry_phases(self, model, params,
+                                                  tmp_path):
+        _save_versions(tmp_path, params, 100, 200)
+        boot, step = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        eng = _engine(model, boot, slots=2)
+        eng.prefill(0, _prompt(seed=9))          # warm decode program
+        toks = np.zeros((eng.slots,), np.int32)
+        act = np.zeros((eng.slots,), bool)
+        act[0] = True
+        eng.decode(toks, act)
+        sched = _sched(eng)
+        rl = sv.HotReloader(sched, str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        with _EventTap() as tap:
+            out = rl.maybe_reload()
+        assert out.ok and out.step == 200 and out.from_step == 100
+        # the restored (committed-placement) candidate reuses the warm
+        # program — an uncommitted boot tree vs committed restore
+        # placement flip would retrace here
+        eng.decode(toks, act)
+        assert eng.decode_compiles() == 1
+        eng.release(0)
+        assert rl.current_step == 200 and rl.previous_step == 100
+        assert eng.weights_version == 1
+        (loaded,) = tap.of("serving_weights_loaded")
+        assert loaded["step"] == 200 and loaded["bytes"] > 0
+        assert loaded["duration_s"] >= 0
+        assert loaded["format_version"] == 1
+        (swapped,) = tap.of("serving_weights_swapped")
+        assert swapped["step"] == 200 and swapped["from_step"] == 100
+        assert swapped["rollback"] is False
+        for phase in ("restore_s", "validate_s", "swap_s"):
+            assert swapped[phase] >= 0
+        assert rl.maybe_reload() is None         # steady state: no-op
+        assert rl.stats["reloads"] == 1
+
+    def test_corrupt_candidate_refused_old_weights_bit_exact(
+            self, model, params, tmp_path):
+        """Failed validate never serves: corrupt AND truncated
+        candidates refuse the swap with the serving params bit-exactly
+        untouched, and the stream keeps decoding on the old weights."""
+        _save_versions(tmp_path, params, 100)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params")
+        eng = _engine(model, boot, slots=2)
+        sched = _sched(eng)
+        rl = sv.HotReloader(sched, str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        before = jax.tree.map(lambda l: np.asarray(l).copy(), eng.params)
+        fi = FaultInjector(FaultPlan(seed=0))
+
+        _save_versions(tmp_path, params, 200)
+        fi.corrupt_checkpoint(
+            os.path.join(str(tmp_path), _ckpt._step_dirname(200)))
+        with _EventTap() as tap:
+            out = rl.reload(step=200)
+        assert not out.ok and out.reason
+        assert rl.current_step == 100 and not rl.can_rollback
+        assert eng.weights_version == 0
+        assert _tree_bytes_equal(eng.params, before)
+        (failed,) = tap.of("serving_reload_failed")
+        assert failed["step"] == 200 and failed["serving_step"] == 100
+
+        _save_versions(tmp_path, params, 300)
+        fi.truncate_checkpoint(
+            os.path.join(str(tmp_path), _ckpt._step_dirname(300)))
+        out = rl.reload(step=300)
+        assert not out.ok
+        assert _tree_bytes_equal(eng.params, before)
+        assert rl.stats["refusals"] == 2
+
+        # the watcher keeps re-offering the refused step until it is
+        # repaired — then the reload goes through (satellite 3's
+        # repaired-step pickup)
+        assert rl.watcher.poll() == 300
+        rz.save_checkpoint(str(tmp_path), 300,
+                           {"params": _mutated(params, 0.3)})
+        out = rl.maybe_reload()
+        assert out.ok and out.step == 300
+        assert rl.current_step == 300
+
+    def test_spec_mismatch_refused_not_raised(self, model, params,
+                                              tmp_path):
+        """A candidate with the wrong structure refuses (ok=False), it
+        does not throw — the server must keep serving."""
+        _save_versions(tmp_path, params, 100)
+        wrong = jax.tree.map(
+            lambda l: jnp.zeros(l.shape + (1,), l.dtype)
+            if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
+        rz.save_checkpoint(str(tmp_path / "wrong"), 200,
+                           {"params": wrong})
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params")
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path / "wrong"),
+                            like={"params": wrong}, params_key="params")
+        out = rl.reload(step=200)
+        assert not out.ok and "leaf" in out.reason
+        assert eng.weights_version == 0
+
+    def test_rollback_bit_exact_and_toggles(self, model, params,
+                                            tmp_path):
+        _save_versions(tmp_path, params, 100, 200)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        original = jax.tree.map(lambda l: np.asarray(l).copy(),
+                                boot)
+        eng = _engine(model, boot, slots=2)
+        sched = _sched(eng)
+        rl = sv.HotReloader(sched, str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        with pytest.raises(RuntimeError):
+            rl.rollback()                        # nothing to roll back to
+        assert rl.reload(step=200).ok
+        with _EventTap() as tap:
+            rb = rl.rollback()
+        assert rb.ok and rb.rollback and rb.step == 100
+        assert rl.current_step == 100 and rl.previous_step == 200
+        assert _tree_bytes_equal(eng.params, original)   # bit-exact
+        (ev,) = tap.of("serving_weights_swapped")
+        assert ev["rollback"] is True and ev["step"] == 100
+        assert "restore_s" not in ev and "validate_s" not in ev
+        rb2 = rl.rollback()                      # toggles back forward
+        assert rb2.ok and rb2.step == 200
+        assert eng.weights_version == 3
+
+    def test_retry_policy_wraps_transient_io_only(self, model, params,
+                                                  tmp_path):
+        """Deterministic corruption propagates through retry_transient
+        immediately (CheckpointError.transient is False) — the refusal
+        path, not an I/O retry loop."""
+        _save_versions(tmp_path, params, 100, 200)
+        FaultInjector(FaultPlan(seed=1)).corrupt_checkpoint(
+            os.path.join(str(tmp_path), _ckpt._step_dirname(200)))
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path),
+                            like={"params": params}, params_key="params",
+                            current_step=100,
+                            retry=rz.RetryPolicy(max_attempts=3))
+        with _EventTap() as tap:
+            out = rl.reload(step=200)
+        assert not out.ok
+        assert tap.of("retry_attempt") == []     # no retries burned
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: reload mid-stream under bursty open-loop load
+# ---------------------------------------------------------------------------
+
+
+def _run_workload_with_swap(model, boot_params, new_params, *,
+                            swap_step, paged=False, tp=None, seed=0,
+                            prefix=False):
+    """Drive a bursty open-loop workload on a virtual clock, swapping
+    weights at scheduler step ``swap_step`` via the step hook; returns
+    (results, engine, refused_or_ok_outcome)."""
+    eng = _engine(model, boot_params, paged=paged, tp=tp)
+    kw = {}
+    if prefix:
+        kw["prefix_caching"] = PrefixCacheConfig(block_size=16,
+                                                 max_tokens=2048)
+    sched = _sched(eng, **kw)
+    outcome = []
+
+    def hook(step, scheduler):
+        if step == swap_step:
+            outcome.append(sched.swap_weights(new_params))
+
+    wl = _workload(seed=seed)
+    out = sv.LoadGenerator(sched, wl, step_time_s=0.05,
+                           step_hook=hook).run()
+    assert out.rejected == []                    # queue sized to fit
+    return out, eng, outcome
+
+
+class TestAcceptanceRun:
+    @pytest.mark.parametrize("paged", [False, True],
+                             ids=["dense", "paged"])
+    def test_mid_stream_swap_zero_dropped_streams(self, model, params,
+                                                  params_v2, paged):
+        """Every offered stream finishes normally across a mid-drain
+        swap — nothing dropped, nothing cancelled — and the run is
+        deterministic: an identical second run produces identical
+        token streams."""
+        out, eng, swapped = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2, paged=paged)
+        assert len(swapped) == 1
+        assert eng.weights_version == 1
+        assert len(out.results) == 6             # ZERO dropped streams
+        for r in out.results.values():
+            assert r.finish_reason in ("eos", "length")
+            assert len(r.tokens) > 0
+        out2, _, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2, paged=paged)
+        assert {k: v.tokens for k, v in out.results.items()} == \
+               {k: v.tokens for k, v in out2.results.items()}
+
+    def test_paged_and_dense_streams_identical_across_swap(
+            self, model, params, params_v2):
+        """The paged engine's identity contract survives a hot swap:
+        same workload, same swap step — dense and paged emit identical
+        token streams."""
+        dense, _, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2, paged=False)
+        paged, _, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2, paged=True)
+        assert {k: v.tokens for k, v in dense.results.items()} == \
+               {k: v.tokens for k, v in paged.results.items()}
+
+    def test_swap_actually_changes_streams(self, model, params,
+                                           params_v2):
+        """An honest witness that the swap serves the NEW weights: the
+        swapped run's streams differ from a never-swapped run's (the
+        mutation is big enough to move greedy argmaxes)."""
+        swapped, _, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=1)
+        plain_eng = _engine(model, params)
+        plain = sv.LoadGenerator(_sched(plain_eng), _workload(),
+                                 step_time_s=0.05).run()
+        assert {k: v.tokens for k, v in swapped.results.items()} != \
+               {k: v.tokens for k, v in plain.results.items()}
+
+    @pytest.mark.skipif(not devices_available(2),
+                        reason=device_count_skip_reason(2))
+    def test_tp2_swap_stream_identical_to_single_chip_swap(
+            self, model, params, params_v2):
+        """tp=2 under a mid-stream swap serves the same tokens as the
+        single-chip engine under the same swap."""
+        single, _, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2)
+        tp2, eng, _ = _run_workload_with_swap(
+            model, params, params_v2, swap_step=2, tp=2)
+        assert eng.tp_size == 2
+        assert {k: v.tokens for k, v in single.results.items()} == \
+               {k: v.tokens for k, v in tp2.results.items()}
+
+    @pytest.mark.skipif(not devices_available(2),
+                        reason=device_count_skip_reason(2))
+    def test_tp2_reloader_restores_onto_mesh(self, model, params,
+                                             tmp_path):
+        """A tp engine's HotReloader derives the mesh shardings
+        automatically: the candidate restores mesh-direct and the swap
+        is a no-op placement."""
+        _save_versions(tmp_path, params, 100, 200)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        eng = _engine(model, boot, slots=2, tp=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        assert rl.shardings is not None          # derived from the mesh
+        out = rl.reload(step=200)
+        assert out.ok and eng.weights_version == 1
+        assert eng.decode_compiles() <= 1
+
+    def test_async_publisher_racing_live_drain(self, model, params,
+                                               tmp_path):
+        """The full loop: an AsyncCheckpointer commits new steps WHILE
+        the scheduler drains a bursty workload; the reloader polls the
+        checkpointer each step and hot-swaps when a commit lands.
+        Zero dropped streams, and the engine ends on the final
+        committed step."""
+        root = str(tmp_path)
+        _save_versions(tmp_path, params, 100)
+        boot, _ = sv.load_serving_params(root, {"params": params},
+                                         params_key="params")
+        eng = _engine(model, boot)
+        sched = _sched(eng)
+        ac = rz.AsyncCheckpointer(rz.CheckpointManager(root, keep=8))
+        rl = sv.HotReloader(
+            sched, root, like={"params": params}, params_key="params",
+            watcher=sv.WeightWatcher(root, checkpointer=ac),
+            current_step=100)
+        published = []
+
+        def hook(step, scheduler):
+            if step == 1:                        # training publishes...
+                published.append(ac.save(200, {
+                    "params": _mutated(params, 0.2)}))
+            rl.maybe_reload()                    # ...serving polls
+
+        wl = _workload()
+        out = sv.LoadGenerator(sched, wl, step_time_s=0.05,
+                               step_hook=hook).run()
+        ac.wait()
+        final = rl.maybe_reload()                # commit may land late
+        assert rl.current_step == 200
+        assert final is None or final.ok
+        assert len(out.results) == 6
+        for r in out.results.values():
+            assert r.finish_reason in ("eos", "length")
+
+    def test_reload_storm_under_overload(self, model, params, tmp_path):
+        """Chaos: forced reload attempts at many step boundaries while
+        a 2x-overload burst drains (queue sized so arrivals shed).
+        Streams that were admitted all finish; the storm's outcome log
+        matches the engine's version count; accounting stays exact."""
+        root = str(tmp_path)
+        _save_versions(tmp_path, params, 100, 200, 300)
+        boot, _ = sv.load_serving_params(root, {"params": params},
+                                         params_key="params", step=100)
+        eng = _engine(model, boot, slots=2)
+        sched = sv.ContinuousBatchingScheduler(
+            eng, max_queue=3, clock=sv.VirtualClock())
+        rl = sv.HotReloader(sched, root, like={"params": params},
+                            params_key="params", current_step=100)
+        storm = ReloadStorm(range(0, 30, 2), reloader=rl, force=True)
+        wl = sv.make_workload(
+            sv.zero_overlap_prompts(10, length=8, vocab=CFG.vocab_size),
+            sv.burst_arrivals(10, burst=5, period_s=0.1),
+            max_new_tokens=6)
+        out = sv.LoadGenerator(
+            sched, wl, step_time_s=0.05,
+            step_hook=sv.chain_hooks(None, storm)).run()
+        assert len(storm.outcomes) >= 3
+        oks = [o for o in storm.outcomes if o is not None and o.ok]
+        assert len(oks) >= 1
+        assert eng.weights_version == len(oks)
+        # overload sheds arrivals (open-loop honesty) but every
+        # ADMITTED stream survived the storm
+        for r in out.results.values():
+            assert r.finish_reason in ("eos", "length")
+        assert len(out.results) + len(out.rejected) == 10
+        assert sched.queue_depth == 0 and sched.active_count == 0
+        sched.close()                            # accounting is clean
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: boot-time degraded start, then repaired-step pickup
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedStart:
+    def test_boot_falls_back_then_hot_reload_picks_up_repair(
+            self, model, params, tmp_path):
+        root = str(tmp_path)
+        _save_versions(tmp_path, params, 1, 2)
+        FaultInjector(FaultPlan(seed=0)).corrupt_checkpoint(
+            os.path.join(root, _ckpt._step_dirname(2)))
+        with _EventTap() as tap:
+            boot, step = sv.load_serving_params(
+                root, {"params": params}, params_key="params")
+        assert step == 1                         # degraded: newest is bad
+        assert len(tap.of("checkpoint_rejected")) >= 1
+        (loaded,) = tap.of("serving_weights_loaded")
+        assert loaded["step"] == 1
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), root, like={"params": params},
+                            params_key="params", current_step=step)
+        assert rl.watcher.poll() == 2            # still offered
+        assert not rl.reload(step=2).ok          # still corrupt: refused
+        rz.save_checkpoint(root, 2, {"params": _mutated(params, 0.002)})
+        out = rl.maybe_reload()                  # repaired: picked up
+        assert out.ok and out.step == 2
+        assert rl.current_step == 2
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache version invalidation
+# ---------------------------------------------------------------------------
+
+
+def _kv_region(seed, n):
+    hd = CFG.hidden_size // CFG.num_attention_heads
+    rng = np.random.default_rng(seed)
+    shape = (CFG.num_hidden_layers, n, CFG.kv_heads, hd)
+    return (jnp.asarray(rng.standard_normal(shape), jnp.float32),
+            jnp.asarray(rng.standard_normal(shape), jnp.float32))
+
+
+class TestPrefixCacheInvalidation:
+    def test_bump_version_invalidates_match_and_reclaims(self):
+        from apex_tpu.serving.prefix_cache import PrefixCache
+
+        pc = PrefixCache(block_size=4, max_tokens=64)
+        a = pc.put(PrefixCache.ROOT, [1, 2, 3, 4], *_kv_region(0, 4))
+        pc.put(a.chain, [5, 6, 7, 8], *_kv_region(1, 4))
+        probe = [1, 2, 3, 4, 5, 6, 7, 8, 9]      # 8 cached + next token
+        assert pc.match(probe)[0] == 8
+        v1 = pc.bump_version()
+        assert v1 == 1 and pc.version == 1
+        assert pc.match(probe)[0] == 0           # stale: unmatchable
+        # unpinned stale entries were dropped at the bump fixpoint
+        assert pc.stale_entries == 0
+        assert pc.stats()["version"] == 1
+
+    def test_stale_pinned_entry_survives_then_drains(self):
+        from apex_tpu.serving.prefix_cache import PrefixCache
+
+        pc = PrefixCache(block_size=4, max_tokens=64)
+        a = pc.put(PrefixCache.ROOT, [1, 2, 3, 4], *_kv_region(0, 4))
+        pc.acquire([a])                          # a live pre-swap stream
+        pc.bump_version()
+        assert pc.stale_entries == 1             # pinned: storage survives
+        assert pc.match([1, 2, 3, 4, 5])[0] == 0   # but never matches
+        pc.release([a])
+        pc.bump_version()                        # next sweep reclaims
+        assert pc.stale_entries == 0
+
+    def test_scheduler_swap_bumps_version_and_recaches(self, model,
+                                                       params,
+                                                       params_v2):
+        """Prefix hits before the swap, version bump at the swap, and
+        post-swap admissions repopulate under the new version — a
+        post-swap stream never resumes from old-weights K/V: it serves
+        exactly what a cold engine on the new weights serves."""
+        shared = sv.shared_prefix_prompts(
+            4, shared_len=32, suffix_len=4, vocab=CFG.vocab_size)
+        eng = _engine(model, params)
+        sched = _sched(eng, prefix_caching=PrefixCacheConfig(
+            block_size=16, max_tokens=2048))
+        for i in range(2):                       # sequential: a1 hits
+            sched.submit(sv.Request(f"a{i}", shared[i],
+                                    max_new_tokens=4))
+            sched.run()
+        stats = sched.prefix_cache.stats()
+        assert stats["hits"] >= 1                # warm before the swap
+        v0 = stats["version"]
+        sched.swap_weights(params_v2)
+        assert sched.prefix_cache.stats()["version"] == v0 + 1
+        for i in range(2, 4):
+            sched.submit(sv.Request(f"a{i}", shared[i],
+                                    max_new_tokens=4))
+            sched.run()
+        # a2 could NOT hit the stale entries; a3 hits a2's
+        # fresh-version capture
+        stats = sched.prefix_cache.stats()
+        assert stats["hits"] >= 2
+        cold = _engine(model, params_v2, slots=2)
+        cs = _sched(cold)
+        cs.submit(sv.Request("a3", shared[3], max_new_tokens=4))
+        want = cs.run()["a3"].tokens
+        assert sched.results["a3"].tokens == want
+
+
+# ---------------------------------------------------------------------------
+# shadow / A-B serving
+# ---------------------------------------------------------------------------
+
+
+class TestShadowAB:
+    def test_assign_arm_deterministic_and_fraction(self):
+        got = [sv.assign_arm(f"r{i}", fraction=0.25, seed=7)
+               for i in range(400)]
+        again = [sv.assign_arm(f"r{i}", fraction=0.25, seed=7)
+                 for i in range(400)]
+        assert got == again                      # stable, no RNG state
+        frac = sum(got) / len(got)
+        assert 0.15 < frac < 0.35                # hash-uniform
+        other = [sv.assign_arm(f"r{i}", fraction=0.25, seed=8)
+                 for i in range(400)]
+        assert got != other                      # seed moves the draw
+        assert not any(sv.assign_arm(f"r{i}", fraction=0.0)
+                       for i in range(50))
+        assert all(sv.assign_arm(f"r{i}", fraction=1.0)
+                   for i in range(50))
+        with pytest.raises(ValueError):
+            sv.assign_arm("r", fraction=1.5)
+
+    def _ab(self, model, primary_params, shadow_params, fraction=0.5,
+            seed=0):
+        clk = sv.VirtualClock()
+        primary = _sched(_engine(model, primary_params), clk)
+        shadow = _sched(_engine(model, shadow_params), clk)
+        return sv.ShadowABScheduler(
+            primary, shadow,
+            sv.ABConfig(fraction=fraction, seed=seed))
+
+    def test_identical_weights_arms_emit_identical_streams(self, model,
+                                                           params):
+        """The null experiment: candidate == incumbent weights ⇒ every
+        mirror copy's stream is bit-identical to its original."""
+        ab = self._ab(model, params, params)
+        wl = _workload()
+        out = sv.LoadGenerator(ab, wl, step_time_s=0.05).run()
+        assert ab.mirrored_rids                  # fraction=0.5 hit some
+        assert ab.mirror_shed == 0
+        shadow_results = ab.shadow.results
+        for rid in ab.mirrored_rids:
+            assert out.results[rid].tokens == \
+                shadow_results["shadow:" + rid].tokens
+
+    def test_seed_deterministic_mirror_and_arm_reports_reconcile(
+            self, model, params, params_v2):
+        """Same seed ⇒ same mirrored set across runs; per-arm reports
+        are built over exactly the recorder's records for that arm,
+        and the candidate arm genuinely served the candidate
+        weights."""
+        clk_runs = []
+        for _ in range(2):
+            ab = self._ab(model, params, params_v2, fraction=0.5,
+                          seed=3)
+            rec = rt.RequestTraceRecorder(clock=ab.clock).install()
+            try:
+                out = sv.LoadGenerator(ab, _workload(),
+                                       step_time_s=0.05).run()
+            finally:
+                rec.uninstall()
+            clk_runs.append((ab, rec, out))
+        (ab1, rec1, out1), (ab2, rec2, out2) = clk_runs
+        assert ab1.mirrored_rids == ab2.mirrored_rids    # seed-stable
+        n_mirror = len(ab1.mirrored_rids)
+        assert 0 < n_mirror < 6
+
+        arms = ab1.arm_records(rec1.records())
+        # reconciliation: one candidate record per mirrored rid, one
+        # incumbent record per mirrored rid — same traffic, both arms
+        assert len(arms["candidate"]) == n_mirror
+        assert len(arms["incumbent"]) == n_mirror
+        assert sorted(r.rid for r in arms["incumbent"]) == \
+            sorted(ab1.mirrored_rids)
+        reports = ab1.arm_reports(rec1.records(),
+                                  deadlines=out1.deadlines,
+                                  arrivals=out1.arrivals,
+                                  duration_s=out1.duration_s)
+        for arm in ("incumbent", "candidate"):
+            assert reports[arm].completed == n_mirror
+            assert reports[arm].offered == n_mirror
+        # different weights: at least one mirrored stream differs
+        shadow_results = ab1.shadow.results
+        diffs = [rid for rid in ab1.mirrored_rids
+                 if out1.results[rid].tokens
+                 != shadow_results["shadow:" + rid].tokens]
+        assert diffs
+
+    def test_users_only_see_incumbent_and_shadow_shed_is_silent(
+            self, model, params, params_v2):
+        """Facade results are the primary's alone; a full shadow queue
+        drops only the mirror copy, never the original."""
+        clk = sv.VirtualClock()
+        primary = _sched(_engine(model, params), clk)
+        shadow = sv.ContinuousBatchingScheduler(
+            _engine(model, params_v2, slots=2), max_queue=1, clock=clk)
+        ab = sv.ShadowABScheduler(primary, shadow,
+                                  sv.ABConfig(fraction=1.0, seed=0))
+        wl = _workload(n=6, burst=6)             # one burst: floods queue
+        out = sv.LoadGenerator(ab, wl, step_time_s=0.05).run()
+        assert out.rejected == []                # incumbent absorbed all
+        assert len(out.results) == 6
+        assert ab.mirror_shed > 0                # shadow queue overflowed
+        assert set(out.results) == {r.rid for r in wl.requests}
+        assert not any(r.startswith("shadow:") for r in out.results)
+
+    def test_facade_rejects_mismatched_construction(self, model,
+                                                    params):
+        clk = sv.VirtualClock()
+        a = _sched(_engine(model, params), clk)
+        b = _sched(_engine(model, params), sv.VirtualClock())
+        with pytest.raises(ValueError):          # clocks must be shared
+            sv.ShadowABScheduler(a, b, sv.ABConfig())
+        with pytest.raises(ValueError):          # distinct schedulers
+            sv.ShadowABScheduler(a, a, sv.ABConfig())
+        with pytest.raises(ValueError):
+            sv.ABConfig(fraction=2.0)
+        with pytest.raises(ValueError):
+            sv.ABConfig(mirror_prefix="")
+
+
+# ---------------------------------------------------------------------------
+# observability wiring + default-off identity (the house rules)
+# ---------------------------------------------------------------------------
+
+
+class TestObservability:
+    def test_loaded_and_swapped_events_feed_metrics(self, model, params,
+                                                    tmp_path):
+        _save_versions(tmp_path, params, 100, 200)
+        restore0 = obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="restore")
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        assert obs_bridge.SERVING_WEIGHTS_STEP.value() == 100
+        assert obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="restore") == restore0 + 1
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        val0 = obs_bridge.SERVING_RELOAD_DURATION.count(phase="validate")
+        swap0 = obs_bridge.SERVING_RELOAD_DURATION.count(phase="swap")
+        assert rl.reload(step=200).ok
+        assert obs_bridge.SERVING_WEIGHTS_STEP.value() == 200
+        assert obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="validate") == val0 + 1
+        assert obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="swap") == swap0 + 1
+        rl.rollback()                            # swap only, no phases
+        assert obs_bridge.SERVING_WEIGHTS_STEP.value() == 100
+        assert obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="validate") == val0 + 1
+        assert obs_bridge.SERVING_RELOAD_DURATION.count(
+            phase="swap") == swap0 + 2
+
+    def test_default_off_byte_identity(self, model, params):
+        """A scheduler with nothing reload-shaped constructed behaves
+        byte-for-byte as before: zero reload events, reload metrics
+        untouched, weights_version pinned at 0, and identical reruns
+        emit identical event streams and token streams."""
+        step0 = obs_bridge.SERVING_WEIGHTS_STEP.value()
+        hist0 = sum(obs_bridge.SERVING_RELOAD_DURATION.count(phase=p)
+                    for p in ("restore", "validate", "swap"))
+
+        def run():
+            eng = _engine(model, params)
+            sched = _sched(eng)
+            with _EventTap() as tap:
+                out = sv.LoadGenerator(sched, _workload(),
+                                       step_time_s=0.05).run()
+            return eng, tap.events, out
+
+        eng1, ev1, out1 = run()
+        eng2, ev2, out2 = run()
+        assert eng1.weights_version == 0
+        for kind in ("serving_weights_loaded", "serving_weights_swapped",
+                     "serving_reload_failed"):
+            assert [e for e in ev1 if e.get("event") == kind] == []
+        # identical reruns: identical event streams (modulo wall-clock
+        # measurement fields) and identical tokens — the determinism
+        # default-off rides on
+        def scrub(events):
+            drop = ("time", "duration_s", "dispatch_s", "restore_s")
+            return [{k: v for k, v in e.items() if k not in drop}
+                    for e in events]
+
+        assert scrub(ev1) == scrub(ev2)
+        assert {k: v.tokens for k, v in out1.results.items()} == \
+               {k: v.tokens for k, v in out2.results.items()}
+        assert obs_bridge.SERVING_WEIGHTS_STEP.value() == step0
+        assert sum(obs_bridge.SERVING_RELOAD_DURATION.count(phase=p)
+                   for p in ("restore", "validate", "swap")) == hist0
+
+    def test_chain_hooks_compose_and_default_off(self):
+        calls = []
+        h = sv.chain_hooks(
+            lambda s, sch: calls.append(("a", s)),
+            None,
+            lambda s, sch: calls.append(("b", s)))
+        h(3, None)
+        assert calls == [("a", 3), ("b", 3)]
+        assert sv.chain_hooks() is None
+        assert sv.chain_hooks(None, None) is None

@@ -54,6 +54,9 @@ _LOWER_IS_BETTER = (
     "_ms", "_s", "_seconds", "_us", "_ns", "p50", "p95", "p99",
     "ttft", "tpot", "latency", "queue_wait", "deadline_misses",
     "step_time", "duration",
+    # hot-reload family: streams dropped across a swap (must trend to
+    # zero) and the A/B mirror's overhead multiplier
+    "dropped", "overhead",
 )
 _ZERO_TOLERANCE = ("compiles",)
 

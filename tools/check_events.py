@@ -71,11 +71,14 @@ ALLOWLIST = {
     "data_stall",
     # serving lifecycle narration: queued is the lifecycle's first
     # breadcrumb (admitted carries the queue-wait measurement); the
-    # step sample's gauges are set directly by the scheduler; weights
-    # loading is a boot-time event
+    # step sample's gauges are set directly by the scheduler
     "serving_request_queued",
     "serving_step",
-    "serving_weights_loaded",
+    # a refused reload carries only a reason string — countable via
+    # apex_events_total{event=}; the phase timings that feed
+    # apex_serving_reload_duration_seconds ride the loaded/swapped
+    # events, which ARE handled
+    "serving_reload_failed",
     # a resume is the second half of a preemption cycle — the
     # apex_serving_preempted_total counter counts cycles once, and the
     # suspension gap is a request-trace annotation, not a metric

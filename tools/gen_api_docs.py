@@ -96,6 +96,7 @@ PAGES = {
         "apex_tpu.serving.scheduler", "apex_tpu.serving.policy",
         "apex_tpu.serving.loadgen",
         "apex_tpu.serving.weights",
+        "apex_tpu.serving.reload",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
         "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
@@ -963,6 +964,68 @@ layer the ROADMAP's SLO-aware-scheduling work is graded by.
 `bench.py`'s `serving_slo` block drives a seeded bursty workload at
 ~1× and ~2× the measured sustainable rate and records p99 TTFT, TPOT
 and goodput at both loads in `PERF_NOTES.md`.
+
+## Hot weight reload & shadow/A-B (`serving.reload`)
+
+A fleet that "serves while you train" cannot drain and restart every
+engine each time training commits a checkpoint.  `serving.reload`
+closes the loop — **default off**: a scheduler that never constructs
+these objects is byte-for-byte the scheduler of the previous section
+(identical event stream, identical metric snapshot, zero new
+compiles — tier-1 pins it).
+
+- **`WeightWatcher`** polls for newer *committed* steps from exactly
+  one source: an in-process `AsyncCheckpointer`'s `last_committed`
+  (set strictly after the atomic commit rename), a supervisor
+  heartbeat file's `ckpt_path` pointer (the cross-process contract —
+  written after commit, so the pointed-at step is always whole), or a
+  raw root walk that skips steps the live-writer registry marks
+  in flight (`resilience.checkpoint.in_flight_steps` — a re-save swaps
+  the committed dir aside mid-commit, and selecting it would race the
+  writer).  A refused candidate is re-offered every poll until
+  repaired or superseded; the watcher never wedges on a bad step.
+- **`HotReloader.reload()`** is restore → validate → swap,
+  **double-buffered**: the candidate restores through the same
+  validated path as boot (`load_serving_params` — v1 + v2 manifests,
+  fused CRC, `shardings=` mesh-direct placement for tp engines,
+  optional `RetryPolicy` on transient I/O) into a fresh buffer that
+  never aliases the serving params.  Corrupt bytes, truncation, or a
+  structure/shape/dtype mismatch against the served tree refuse the
+  swap (`ok=False` + a `serving_reload_failed` event) with serving
+  bit-exactly untouched.  The swap itself
+  (`scheduler.swap_weights`) happens at a step boundary: in-flight
+  streams keep their KV cache and sampler state and continue under
+  the new weights — post-swap tokens are bit-identical to a fresh
+  engine booted on the new weights and fed the same state — and the
+  prefix cache is **version-bumped** so old-weights K/V can never
+  resume a new-weights stream.  The same-spec contract means every
+  compiled program family re-dispatches unchanged: a swap adds zero
+  compiles.
+- **`HotReloader.rollback()`**: the displaced buffer is retained (one
+  previous version), and rollback swaps it back through the identical
+  mechanism — prefix-cache invalidation included, bit-exact to the
+  pre-reload engine.
+- **Shadow/A-B** (`ShadowABScheduler`): two weight versions behind one
+  serving facade.  `assign_arm` (a seeded rid hash — deterministic
+  across runs, processes, and submission order) mirrors a traffic
+  fraction: originals keep serving from the incumbent (users only ever
+  see incumbent output) while copies run on a shadow scheduler holding
+  candidate weights, both on one shared (virtual) clock.  A full
+  shadow queue drops only the mirror copy — shadow traffic never
+  degrades incumbent service.  `arm_reports()` builds per-arm
+  `SLOReport`s over the *same* mirrored traffic — candidate vs
+  incumbent on identical requests, the promotion comparison.
+
+Observability: boot load and every swap/rollback set
+`apex_serving_weights_step`; phase timings land in
+`apex_serving_reload_duration_seconds{phase=restore|validate|swap}`
+(`swap` is the only phase the serving loop ever waits on).  Chaos
+coverage drives corrupt/truncated candidates mid-reload, a simulated
+writer crash racing the watcher, and a reload storm under 2x overload
+— every perturbation must leave the engine serving the last-good
+weights with all streams intact.  `bench.py`'s `serving_reload` block
+measures the swap pause (p99 step-time inflation during reload vs
+steady state), reload wall time, and the A/B mirror overhead.
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -1040,6 +1103,8 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_tenant_inflight{tenant}` | gauge | scheduler, every step while a scheduling policy is enabled (active streams per tenant) |
 | `apex_serving_tp_size` | gauge | `serving_tp_step` events (tensor-parallel mesh width the decode programs run over; 1 == single-chip) |
 | `apex_serving_collective_seconds` | histogram | `serving_tp_step` events (tp decode step wall time, dispatch → completion — an upper bound on per-step collective cost) |
+| `apex_serving_weights_step` | gauge | `serving_weights_loaded` / `serving_weights_swapped` events (training step of the weights currently serving — boot load, hot swap, and rollback all set it) |
+| `apex_serving_reload_duration_seconds{phase}` | histogram | `serving_weights_loaded` (phase=`restore`) and `serving_weights_swapped` (phase=`validate`\|`swap`) events — hot-reload phase wall time; `swap` is the only phase the serving loop waits on |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -1641,6 +1706,52 @@ overloaded workload FIFO-vs-policy and records the honest
 high-priority p99 TTFT and goodput deltas in `PERF_NOTES.md`; chaos
 drivers (`SlowDecodeStep`, `StallStream`, `CancelStorm`) let tier-1
 prove every surviving stream is token-identical under fire.
+
+Serve while you train — training keeps committing checkpoints; the
+server picks each one up **without dropping a stream**: a watcher
+polls for newer committed steps, the candidate restores
+double-buffered through the same validated path as boot (a corrupt
+candidate refuses the swap with serving untouched), and the swap
+happens at a step boundary with in-flight streams preserved, the
+prefix cache version-invalidated, and the previous weights retained
+for one-step rollback ([full page](api/serving.md)):
+
+```python
+from apex_tpu import resilience as rz, serving as sv
+
+# training side (possibly another process): AsyncCheckpointer commits
+# steps under root; the supervisor heartbeat points at the last commit
+reloader = sv.HotReloader(
+    sched, "/ckpts/run7", like=template, params_key="params",
+    watcher=sv.WeightWatcher("/ckpts/run7",
+                             heartbeat_path="/ckpts/run7/heartbeat"),
+    retry=rz.RetryPolicy(max_attempts=4))   # transient I/O only
+
+while serving:                     # the serving loop, unchanged...
+    sched.step()
+    out = reloader.maybe_reload()  # ...plus one cheap poll per step
+    if out is not None and not out.ok:
+        log.warning("candidate %s refused: %s", out.step, out.reason)
+if regression_detected:
+    reloader.rollback()            # bit-exact one-step undo
+
+# A/B the candidate before promoting: mirror 10% of traffic onto a
+# shadow engine holding the new weights (users see incumbent output)
+ab = sv.ShadowABScheduler(sched, shadow_sched,
+                          sv.ABConfig(fraction=0.1, seed=7))
+with obs.recording_requests() as rec:
+    sv.LoadGenerator(ab, wl).run()
+reports = ab.arm_reports(rec.records())   # candidate vs incumbent
+```
+
+Post-swap tokens are bit-identical to a fresh engine booted on the
+new weights and fed the same state; a refused candidate (corrupt,
+truncated, wrong shape) leaves serving bit-exactly on the old
+weights; a swap adds **zero** new compiles (same-spec contract).  The
+step being served rides `apex_serving_weights_step`, phase timings
+ride `apex_serving_reload_duration_seconds{phase}`, and `bench.py`'s
+`serving_reload` block records the honest swap pause (p99 step-time
+inflation during a mid-traffic reload) in `PERF_NOTES.md`.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -88,3 +88,8 @@ register("serving_tp", "tensor-parallel serving: DecodeEngine sharded over a 1-D
          "tp mesh (Megatron column/row params, head-split KV cache, replicated "
          "tables/lengths; token-identical greedy streams, one psum pair per layer)",
          False, "shard_map over the same jitted serving programs")
+register("serving_fleet", "fault-tolerant fleet serving: prefix-affinity/WRR "
+         "replica router with heartbeat health states, lossless stream failover "
+         "(bit-exact capture-resume or deterministic replay), rolling drain, "
+         "and replica-scale chaos (kill/wedge/slow)",
+         False, "host-side router over N scheduler replicas")

@@ -191,6 +191,38 @@ SERVING_RELOAD_DURATION = metrics.histogram(
     "place), validate (pre-swap spec gate), swap (pointer swap + "
     "prefix-cache invalidation — the only phase the serving loop "
     "ever waits on)", ("phase",))
+SERVING_FLEET_REPLICAS_HEALTHY = metrics.gauge(
+    "apex_serving_fleet_replicas_healthy",
+    "replicas in the HEALTHY state (refreshed every fleet router "
+    "step; suspect/draining/dead replicas do not count)")
+SERVING_FLEET_ROUTED = metrics.counter(
+    "apex_serving_fleet_routed_total",
+    "requests placed onto a replica by the fleet router (affinity or "
+    "WRR; cardinality bounded by the fleet size)", ("replica",))
+SERVING_FLEET_TRANSITIONS = metrics.counter(
+    "apex_serving_fleet_transitions_total",
+    "replica health-state transitions, by destination state",
+    ("state",))
+SERVING_FLEET_FAILOVERS = metrics.counter(
+    "apex_serving_fleet_failovers_total",
+    "streams evacuated from a dead or draining replica, by mode "
+    "(capture-resume: cache bytes travel, bit-exact mid-stream; "
+    "requeue: deterministic replay from the request record)",
+    ("mode",))
+SERVING_FLEET_RESUMES = metrics.counter(
+    "apex_serving_fleet_resumes_total",
+    "failover victims that landed on a survivor with their captured "
+    "cache intact (mid-stream bit-exact resumes; requeued victims "
+    "count in failovers only)")
+SERVING_FLEET_SHED = metrics.counter(
+    "apex_serving_fleet_shed_total",
+    "requests the fleet router shed: every healthy replica at "
+    "capacity, no replica available, or a failover victim that no "
+    "surviving capacity could absorb")
+SERVING_FLEET_FAILOVER_SECONDS = metrics.histogram(
+    "apex_serving_fleet_failover_seconds",
+    "replica failure (or drain) to the victim stream landing on a "
+    "survivor, per stream, on the fleet's shared clock")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -346,6 +378,33 @@ def _on_serving_weights_swapped(event: dict) -> None:
             SERVING_RELOAD_DURATION.observe(v, phase=phase)
 
 
+def _on_serving_fleet_routed(event: dict) -> None:
+    SERVING_FLEET_ROUTED.inc(
+        replica=str(event.get("replica", "unknown")))
+
+
+def _on_serving_fleet_replica_state(event: dict) -> None:
+    SERVING_FLEET_TRANSITIONS.inc(
+        state=str(event.get("state", "unknown")))
+
+
+def _on_serving_fleet_failover(event: dict) -> None:
+    SERVING_FLEET_FAILOVERS.inc(
+        mode=str(event.get("mode", "unknown")))
+
+
+def _on_serving_fleet_resumed(event: dict) -> None:
+    if event.get("mode") == "capture-resume":
+        SERVING_FLEET_RESUMES.inc()
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_FLEET_FAILOVER_SECONDS.observe(duration_s)
+
+
+def _on_serving_fleet_shed(event: dict) -> None:
+    SERVING_FLEET_SHED.inc()
+
+
 _HANDLERS = {
     "retry_attempt": _on_retry_attempt,
     "retry_exhausted": _on_retry_exhausted,
@@ -370,6 +429,11 @@ _HANDLERS = {
     "serving_tp_step": _on_serving_tp_step,
     "serving_weights_loaded": _on_serving_weights_loaded,
     "serving_weights_swapped": _on_serving_weights_swapped,
+    "serving_fleet_routed": _on_serving_fleet_routed,
+    "serving_fleet_replica_state": _on_serving_fleet_replica_state,
+    "serving_fleet_failover": _on_serving_fleet_failover,
+    "serving_fleet_resumed": _on_serving_fleet_resumed,
+    "serving_fleet_shed": _on_serving_fleet_shed,
 }
 
 

@@ -51,6 +51,19 @@ under (wired through
   mass-disconnect burst (a gateway restart) that exercises slot/block/
   pin release under load.
 
+The fleet PR adds the *replica-scale* faults the
+:class:`~apex_tpu.serving.fleet.FleetRouter` is graded under (same
+``step_hook`` wiring, router in place of the scheduler):
+
+- **Replica loss**: :class:`KillReplica` hard-kills a replica at a
+  chosen step — device memory gone, streams re-queue on survivors and
+  replay deterministically.
+- **Replica hang**: :class:`WedgeReplica` stops a replica's heartbeats
+  so the watchdog declares it dead and drains it via preempt-capture.
+- **Replica straggler**: :class:`SlowReplica` makes a replica miss
+  chosen beats while the shared clock inflates — SUSPECT then recover,
+  token streams untouched.
+
 PR 3 adds the *pod-scale* faults the elastic/consistency layer exists
 to survive:
 
@@ -86,12 +99,15 @@ __all__ = [
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
+    "KillReplica",
     "ReloadStorm",
     "SimulatedPreemption",
     "SimulatedWriterCrash",
     "SlowDecodeStep",
+    "SlowReplica",
     "SlowStep",
     "StallStream",
+    "WedgeReplica",
 ]
 
 
@@ -506,6 +522,102 @@ class ReloadStorm:
         else:
             out = self.reloader.maybe_reload()
         self.outcomes.append(out)
+
+
+# -- fleet faults (ISSUE 17) ------------------------------------------------
+
+
+class KillReplica:
+    """Hard-kill a fleet replica at a chosen step (device memory
+    lost).
+
+    Install as a :class:`~apex_tpu.serving.loadgen.LoadGenerator`
+    ``step_hook`` driving a
+    :class:`~apex_tpu.serving.fleet.FleetRouter`: at the configured
+    (0-based) step the router's :meth:`~apex_tpu.serving.fleet.
+    FleetRouter.kill` fires — the victim's in-flight streams re-queue
+    on survivors from their host-side request records and **replay
+    deterministically** (the final token streams are bit-identical to
+    an unperturbed run; the device cache is honestly gone, so the
+    already-emitted tokens are re-earned, not restored).  The killed
+    scheduler is routed through ``close()`` so prefix pins and paged
+    block holds never leak.
+    """
+
+    def __init__(self, replica: str, *, at_step: int):
+        if at_step < 0:
+            raise ValueError(f"at_step must be >= 0, got {at_step}")
+        self.replica = str(replica)
+        self.at_step = int(at_step)
+        self.killed = False
+
+    def __call__(self, step: int, router) -> None:
+        if self.killed or int(step) != self.at_step:
+            return
+        emit_event("fault_injected", fault="kill_replica",
+                   replica=self.replica, step=int(step))
+        router.kill(self.replica)
+        self.killed = True
+
+
+class WedgeReplica:
+    """Hard-hang a fleet replica at a chosen step: its steps never
+    complete again, so it stops heartbeating and the router's watchdog
+    walks it HEALTHY → SUSPECT → DEAD on the shared clock, then drains
+    it via preempt-capture (host and device state are intact — a hang
+    is not a loss), resuming dense victims on survivors **mid-stream,
+    bit-exactly**.
+    """
+
+    def __init__(self, replica: str, *, at_step: int):
+        if at_step < 0:
+            raise ValueError(f"at_step must be >= 0, got {at_step}")
+        self.replica = str(replica)
+        self.at_step = int(at_step)
+        self.wedged = False
+
+    def __call__(self, step: int, router) -> None:
+        if self.wedged or int(step) != self.at_step:
+            return
+        emit_event("fault_injected", fault="wedge_replica",
+                   replica=self.replica, step=int(step))
+        router.wedge(self.replica)
+        self.wedged = True
+
+
+class SlowReplica:
+    """Straggler replica: at each configured step the replica's step
+    fails to complete within the boundary (one missed heartbeat per
+    configured step) and the shared clock inflates by ``extra_s``.  A
+    run of stalls longer than ``suspect_after_s`` drives the replica
+    SUSPECT (placements route around it); shorter than
+    ``dead_after_s`` it recovers on its next completed beat — HEALTHY
+    again with WRR credits reset.  Token streams must not move a bit
+    (clock feeds health and telemetry, never token choice).
+    """
+
+    def __init__(self, replica: str, steps: Iterable[int],
+                 extra_s: float, *, clock):
+        if extra_s <= 0:
+            raise ValueError(f"extra_s must be > 0, got {extra_s}")
+        if not hasattr(clock, "advance"):
+            raise ValueError(
+                "SlowReplica needs an advanceable clock — pass the "
+                "fleet's VirtualClock (a real monotonic clock cannot "
+                "be inflated)")
+        self.replica = str(replica)
+        self.steps = frozenset(int(s) for s in steps)
+        self.extra_s = float(extra_s)
+        self._clock = clock
+
+    def __call__(self, step: int, router) -> None:
+        if int(step) not in self.steps:
+            return
+        emit_event("fault_injected", fault="slow_replica",
+                   replica=self.replica, step=int(step),
+                   extra_s=self.extra_s)
+        router.stall(self.replica)
+        self._clock.advance(self.extra_s)
 
 
 # -- pod-scale faults (PR 3) -----------------------------------------------

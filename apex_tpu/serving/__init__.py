@@ -177,7 +177,9 @@ from apex_tpu.serving.scheduler import (
     RequestPhase,
     RequestResult,
     SchedulerStalled,
+    StreamExport,
 )
+from apex_tpu.serving.fleet import FleetConfig, FleetRouter, ReplicaState
 from apex_tpu.serving.reload import (
     ABConfig,
     HotReloader,
@@ -221,6 +223,10 @@ __all__ = [
     "RequestPhase",
     "RequestResult",
     "SchedulerStalled",
+    "StreamExport",
+    "FleetConfig",
+    "FleetRouter",
+    "ReplicaState",
     "SchedulingPolicy",
     "WeightedRoundRobin",
     "SERVED_REASONS",

@@ -48,14 +48,13 @@ percentile reports.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.serving.scheduler import (
     SERVED_REASONS,
-    ContinuousBatchingScheduler,
     QueueFull,
     Request,
     RequestResult,
@@ -380,14 +379,23 @@ class LoadGenerator:
     :class:`~apex_tpu.resilience.fault_injection.CancelStorm` drive
     deterministic cancellations mid-run.  ``None`` (the default) runs
     exactly the pre-hook loop.
+
+    The target is duck-typed: anything exposing the scheduler surface
+    the loop uses (``submit`` / ``step`` / ``results`` / ``clock`` /
+    ``queue_depth`` / ``active_count`` / ``suspended_count``) drives
+    identically — a bare
+    :class:`~apex_tpu.serving.scheduler.ContinuousBatchingScheduler`,
+    a :class:`~apex_tpu.serving.reload.ShadowABScheduler`, or a
+    :class:`~apex_tpu.serving.fleet.FleetRouter` fronting N replicas.
+    Fleet chaos (:class:`~apex_tpu.resilience.fault_injection.
+    KillReplica` and friends) rides the same ``step_hook``, receiving
+    the router.
     """
 
-    def __init__(self, scheduler: ContinuousBatchingScheduler,
-                 workload: OpenLoopWorkload, *,
+    def __init__(self, scheduler, workload: OpenLoopWorkload, *,
                  step_time_s: Optional[float] = None,
                  max_steps: Optional[int] = None,
-                 step_hook: Optional[Callable[
-                     [int, ContinuousBatchingScheduler], None]] = None):
+                 step_hook: Optional[Callable[[int, Any], None]] = None):
         clock = scheduler.clock
         if step_time_s is not None:
             if step_time_s <= 0:

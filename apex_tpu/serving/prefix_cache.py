@@ -313,6 +313,26 @@ class PrefixCache:
         self._touch(entry)
         return entry
 
+    def probe(self, prompt: Sequence[int]) -> int:
+        """READ-ONLY coverage probe: tokens the longest cached chain
+        would cover for ``prompt`` (same walk and ``len(prompt) - 1``
+        cap as :meth:`match`) with **no side effects** — no LRU touch,
+        no hit/miss accounting, no pinning.  The fleet router's
+        prefix-affinity placement probes every replica's cache per
+        submission; :meth:`match` here would skew each replica's own
+        hit-rate stats and recency order with placement traffic the
+        replica never served."""
+        n = len(prompt)
+        h = _ROOT
+        pos = 0
+        while pos + self.block_size <= n - 1:
+            h = self.chain_hash(h, prompt[pos:pos + self.block_size])
+            entry = self._entries.get(h)
+            if entry is None or entry.version != self._version:
+                break
+            pos += self.block_size
+        return pos
+
     # ---- pinning ---------------------------------------------------------
     def acquire(self, entries: Sequence[_Entry]) -> None:
         """Pin entries feeding a live slot: refs > 0 blocks eviction."""

@@ -203,6 +203,11 @@ class HotReloader:
       (double buffering — one previous version, the production
       playbook's one-step undo); ``rollback()`` swaps it back through
       the identical mechanism, prefix-cache invalidation included.
+    - **Restore-ahead.**  :meth:`prefetch` stages the next candidate
+      (restore + validate) off the serving path at any time; the
+      matching ``reload()`` then pauses serving only for the pointer
+      swap.  The staged buffer is a third, invisible buffer — staging
+      never touches the serving or rollback params.
 
     ``retry`` (a :class:`RetryPolicy`) retries *transient* I/O during
     the restore; deterministic corruption propagates immediately into
@@ -237,8 +242,11 @@ class HotReloader:
         self.shardings = shardings
         self._current_step = current_step
         self._previous: Optional[tuple] = None   # (params, step)
+        self._staged: Optional[tuple] = None     # (params, step,
+        #                                           restore_s, validate_s)
         self._reloads = 0
         self._refusals = 0
+        self._prefetches = 0
 
     # ---- introspection ---------------------------------------------------
     @property
@@ -257,8 +265,15 @@ class HotReloader:
         return self._previous is not None
 
     @property
+    def staged_step(self) -> Optional[int]:
+        """Step of the restore-ahead candidate staged by
+        :meth:`prefetch`, or None when nothing is staged."""
+        return self._staged[1] if self._staged is not None else None
+
+    @property
     def stats(self) -> Dict[str, int]:
         return {"reloads": self._reloads, "refusals": self._refusals,
+                "prefetches": self._prefetches,
                 "watcher_polls": self.watcher.polls}
 
     # ---- the lifecycle ---------------------------------------------------
@@ -283,16 +298,30 @@ class HotReloader:
             version=int(self.engine.weights_version), reason=reason,
             restore_s=restore_s, validate_s=validate_s)
 
-    def reload(self, *, step: Optional[int] = None) -> ReloadOutcome:
-        """Restore → validate → swap, double-buffered.
+    def prefetch(self, *, step: Optional[int] = None) -> Optional[int]:
+        """Restore-ahead: stage the next candidate off the serving
+        path, so the step-boundary :meth:`reload` pause is just the
+        pointer swap (``swap_s``, ~1 ms) instead of being dominated by
+        the restore (~tens of ms for even a small model).
 
-        ``step`` pins the candidate (the watcher path); ``None`` takes
-        the newest valid committed step.  Call at a step boundary only
-        (between ``scheduler.step()`` calls — e.g. a loadgen
-        ``step_hook``).  Never raises for a bad candidate: refusal is
-        an outcome (``ok=False`` + a ``serving_reload_failed`` event),
-        because the server must keep serving.
+        Restores and validates the candidate into a staged buffer
+        right now (safe at any time — the serving params are never
+        touched) and returns the staged step, or None when nothing
+        could be staged (no committed step, restore failure, or spec
+        mismatch — logged, not a formal refusal: nothing was offered
+        for serving, and the later :meth:`reload` re-walks the full
+        path and refuses first-class).  A later ``reload()`` whose
+        target matches the staged step consumes the stage and skips
+        straight to the swap; a non-matching target discards the stale
+        stage and restores fresh.
         """
+        if step is None:
+            step = self.watcher.committed_step()
+            if step is None:
+                return None
+        if (self._staged is not None
+                and self._staged[1] == int(step)):
+            return int(step)             # already staged — idempotent
         t0 = self._clock()
 
         def _restore():
@@ -307,22 +336,83 @@ class HotReloader:
             else:
                 candidate, got = _restore()
         except Exception as e:
-            # the double-buffer guarantee: the failure happened entirely
-            # inside the candidate buffer — serving params untouched
-            return self._refuse(step, f"{type(e).__name__}: {e}",
-                                self._clock() - t0, 0.0)
+            logger.warning("prefetch failed (step %s): %s: %s",
+                           step, type(e).__name__, e)
+            return None
         restore_s = self._clock() - t0
-
-        # validation gate against the SERVED tree: structure + leaf
-        # shape/dtype must match or every compiled program would
-        # retrace.  swap_params enforces this too — checking here makes
-        # the refusal a first-class outcome instead of an exception,
-        # and times the phase separately from the pointer swap.
         t1 = self._clock()
         mismatch = self._spec_mismatch(candidate)
         validate_s = self._clock() - t1
         if mismatch is not None:
-            return self._refuse(got, mismatch, restore_s, validate_s)
+            logger.warning("prefetch staged nothing (step %s): %s",
+                           got, mismatch)
+            return None
+        self._staged = (candidate, int(got), restore_s, validate_s)
+        self._prefetches += 1
+        return int(got)
+
+    def reload(self, *, step: Optional[int] = None) -> ReloadOutcome:
+        """Restore → validate → swap, double-buffered.
+
+        ``step`` pins the candidate (the watcher path); ``None`` takes
+        the newest valid committed step.  Call at a step boundary only
+        (between ``scheduler.step()`` calls — e.g. a loadgen
+        ``step_hook``).  Never raises for a bad candidate: refusal is
+        an outcome (``ok=False`` + a ``serving_reload_failed`` event),
+        because the server must keep serving.
+
+        When :meth:`prefetch` staged this exact step, the restore and
+        validate phases were already paid off the serving path: the
+        boundary pause here is only the swap.  The emitted timings
+        keep the staged restore_s/validate_s (the work was real — it
+        just didn't stall serving) plus ``prefetched=True``.
+        """
+        candidate = None
+        prefetched = False
+        if self._staged is not None:
+            want = step if step is not None \
+                else self.watcher.committed_step()
+            if want is not None and int(want) == self._staged[1]:
+                candidate, got, restore_s, validate_s = self._staged
+                prefetched = True
+            self._staged = None          # consumed or stale either way
+
+        if candidate is None:
+            t0 = self._clock()
+
+            def _restore():
+                return load_serving_params(
+                    self.root, self.like, params_key=self.params_key,
+                    policy=self.policy, step=step,
+                    shardings=self.shardings)
+
+            try:
+                if self.retry is not None:
+                    candidate, got = retry_transient(
+                        _restore, policy=self.retry,
+                        what="serving_reload")
+                else:
+                    candidate, got = _restore()
+            except Exception as e:
+                # the double-buffer guarantee: the failure happened
+                # entirely inside the candidate buffer — serving
+                # params untouched
+                return self._refuse(step, f"{type(e).__name__}: {e}",
+                                    self._clock() - t0, 0.0)
+            restore_s = self._clock() - t0
+
+            # validation gate against the SERVED tree: structure +
+            # leaf shape/dtype must match or every compiled program
+            # would retrace.  swap_params enforces this too — checking
+            # here makes the refusal a first-class outcome instead of
+            # an exception, and times the phase separately from the
+            # pointer swap.
+            t1 = self._clock()
+            mismatch = self._spec_mismatch(candidate)
+            validate_s = self._clock() - t1
+            if mismatch is not None:
+                return self._refuse(got, mismatch, restore_s,
+                                    validate_s)
 
         t2 = self._clock()
         displaced = self.scheduler.swap_weights(candidate)
@@ -335,6 +425,7 @@ class HotReloader:
         version = int(self.engine.weights_version)
         emit_event("serving_weights_swapped", step=int(got),
                    from_step=from_step, version=version, rollback=False,
+                   prefetched=prefetched,
                    restore_s=round(restore_s, 6),
                    validate_s=round(validate_s, 6),
                    swap_s=round(swap_s, 6))

@@ -122,7 +122,7 @@ from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 
 __all__ = ["Request", "RequestPhase", "RequestResult", "QueueFull",
-           "SchedulerStalled", "SERVED_REASONS",
+           "SchedulerStalled", "SERVED_REASONS", "StreamExport",
            "ContinuousBatchingScheduler"]
 
 logger = get_logger("serving.scheduler")
@@ -220,6 +220,38 @@ class _Active:
     @property
     def prompt_remaining(self) -> int:
         return len(self.request.prompt) - self.prompt_pos
+
+
+@dataclasses.dataclass
+class StreamExport:
+    """One live stream in portable form — the unit of fleet failover
+    (:meth:`ContinuousBatchingScheduler.export_streams` produces them,
+    :meth:`ContinuousBatchingScheduler.adopt_stream` consumes them on a
+    *different* scheduler).
+
+    Two fidelities:
+
+    - ``kv`` present (dense engines, streams that reached DECODE):
+      the captured cache bytes travel with the stream, so adoption
+      restores mid-stream **bit-exactly** — same tokens kept, decode
+      continues as if nothing happened (the PR 13 capture/restore
+      contract, applied cross-engine per PR 14).
+    - ``kv`` absent (hard-killed engine, mid-PREFILL streams, queued
+      requests, or any stream on a *paged* engine — paged capture is
+      by block reference into a per-engine pool and cannot cross
+      engines): adoption re-queues the bare request.  Replay is
+      deterministic (sampler keys fold from ``seed`` by token index),
+      so the *final* token stream is still bit-identical to an
+      uninterrupted run — the tokens are re-earned, not lost.
+    """
+
+    request: Request
+    t_submit: float                   # original submit stamp, preserved
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    t_first: float = 0.0
+    preemptions: int = 0
+    length: int = 0                   # cached rows at capture
+    kv: Optional[tuple] = None        # dense (k, v) host arrays
 
 
 @dataclasses.dataclass
@@ -920,6 +952,136 @@ class ContinuousBatchingScheduler:
         """The live :class:`PrefixCache` when ``prefix_caching`` is
         enabled (``None`` otherwise) — introspection for tests/bench."""
         return self._prefix
+
+    # ---- fleet failover (export / adopt) ---------------------------------
+    def export_streams(self, *, capture: bool = True
+                       ) -> List[StreamExport]:
+        """Evacuate EVERY live stream — queued, active, suspended —
+        into portable :class:`StreamExport` records, releasing this
+        scheduler's slots, paged block holds, and prefix-cache pins on
+        the way out.  Unlike :meth:`cancel`, nothing terminal is
+        recorded and no per-request events fire: the streams are not
+        ending, they are *moving* (the fleet router narrates the move
+        with its own ``serving_fleet_*`` events).  After export the
+        scheduler is drained, so :meth:`close` succeeds.
+
+        ``capture=True`` (a wedged-but-intact replica, or a rolling
+        drain) snapshots each dense DECODE stream's cache so adoption
+        elsewhere resumes mid-stream bit-exactly.  ``capture=False``
+        models a hard-killed replica: the device cache is gone, only
+        host-side request records survive — every stream exports bare
+        and replays deterministically on adoption.  Paged streams
+        always export bare (their capture is by block reference into
+        this engine's pool; the bytes cannot cross engines).
+
+        Records come back in original admission/arrival order so a
+        router re-placing them preserves FIFO fairness within a
+        priority class."""
+        out: List[StreamExport] = []
+        dense = not self._paged
+        # active streams, admission order (DECODE streams carry their
+        # cache when capture is possible; mid-PREFILL streams are
+        # cheaper to replay than to capture — same rule as _preempt)
+        for slot, st in sorted(self._active.items(),
+                               key=lambda kv_: kv_[1].seq):
+            exp = StreamExport(request=st.request, t_submit=st.t_submit,
+                               preemptions=st.preemptions)
+            if (capture and dense
+                    and st.phase is RequestPhase.DECODE):
+                length = int(self.engine.lengths()[slot])
+                k, v, _ = self.engine.capture_slot(slot)
+                exp.kv = (k, v)
+                exp.length = length
+                exp.tokens = list(st.tokens)
+                exp.t_first = st.t_first
+            if self._prefix is not None:
+                self._release_pins(st)
+            self._active.pop(slot)
+            self.engine.release(slot)
+            self._live_rids.discard(st.request.rid)
+            out.append(exp)
+        # suspended streams: the dense capture already exists — it is
+        # portable as-is; paged holds are dropped (pool-local)
+        for sus in self._suspended:
+            st = sus.st
+            exp = StreamExport(request=st.request, t_submit=st.t_submit,
+                               preemptions=st.preemptions)
+            if capture and dense and sus.kv is not None:
+                exp.kv = sus.kv
+                exp.length = sus.length
+                exp.tokens = list(st.tokens)
+                exp.t_first = st.t_first
+            self._drop_suspended_state(sus)
+            self._live_rids.discard(st.request.rid)
+            out.append(exp)
+        self._suspended = []
+        # the queue, arrival order
+        for request, t_submit in self._queue:
+            out.append(StreamExport(request=request, t_submit=t_submit))
+            self._live_rids.discard(request.rid)
+        self._queue.clear()
+        return out
+
+    def adopt_stream(self, exp: StreamExport) -> bool:
+        """Take over one exported stream.  A bare record (``kv`` is
+        ``None``) re-enters the queue with its ORIGINAL submit stamp —
+        queue-wait and TTFT accounting keep charging from the first
+        submission, so failover can never flatter the latency
+        distribution.  A captured record needs a free slot: the cache
+        bytes are restored and decode continues mid-stream,
+        bit-exactly (returns ``False`` — without consuming the record
+        — when every slot is busy; the router retries next step).
+        Raises ``ValueError`` on a rid already live here and, for
+        captured records, on a paged engine (restore needs the dense
+        ``restore_prefix`` write path)."""
+        request = exp.request
+        if request.rid in self._live_rids:
+            raise ValueError(
+                f"adopt_stream({request.rid!r}): rid already live on "
+                f"this scheduler")
+        if exp.kv is None:
+            if len(self._queue) >= self.max_queue:
+                raise QueueFull(
+                    f"queue at capacity ({self.max_queue})")
+            self._queue.append((request, exp.t_submit))
+            self._live_rids.add(request.rid)
+            if self.policy is not None:
+                self._tenants_seen.add(request.tenant)
+            emit_event("serving_request_queued", rid=request.rid,
+                       prompt_tokens=len(request.prompt),
+                       queue_depth=len(self._queue))
+            return True
+        if self._paged:
+            raise ValueError(
+                f"adopt_stream({request.rid!r}): captured K/V cannot "
+                f"restore into a paged engine — export the donor with "
+                f"capture=False (requeue) instead")
+        free = [s for s in self.engine.free_slots()
+                if s not in self._active]
+        if not free:
+            return False
+        slot = free[0]
+        self.engine.restore_prefix(slot, exp.kv, exp.length)
+        st = _Active(request=request, slot=slot, seq=self._admit_seq,
+                     base_key=np.asarray(request_key(request.seed)),
+                     tokens=list(exp.tokens), t_submit=exp.t_submit,
+                     t_first=exp.t_first,
+                     prompt_pos=len(request.prompt),
+                     phase=RequestPhase.DECODE,
+                     draft_k=(self.speculation.max_draft
+                              if self.speculation is not None
+                              and request.temperature <= 0 else 0),
+                     preemptions=exp.preemptions + 1,
+                     wv=int(getattr(self.engine, "weights_version", 0)))
+        self._admit_seq += 1
+        self._active[slot] = st
+        self._live_rids.add(request.rid)
+        if self.policy is not None:
+            self._tenants_seen.add(request.tenant)
+        emit_event("serving_request_resumed", rid=request.rid,
+                   slot=slot, cached_tokens=exp.length,
+                   suspended_s=None)
+        return True
 
     def close(self) -> None:
         """Tear down this scheduler's prefix cache: drop every entry

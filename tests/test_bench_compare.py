@@ -39,6 +39,17 @@ GOLDEN_OLD = {
         "decode_compiles_after_warmup": 1,
         "config": {"reload_at_step": 4},
     },
+    "serving_fleet": {
+        "ok": True,
+        "failover_latency_s": 0.02,
+        "throughput_vs_baseline": 0.7,
+        "goodput_delta": 0.3,
+        "dropped_streams": 0,
+        "shed": 0,
+        "resumed": 3,
+        "decode_compiles": 3,
+        "config": {"kill_step": 4},
+    },
 }
 
 
@@ -104,6 +115,29 @@ class TestClassify:
         for count in ("preempted", "resumed", "shed", "hp_served",
                       "completed"):
             assert bc.classify(f"{base}.policy.{count}") is None, count
+
+    def test_fleet_family_direction_aware(self):
+        """The ISSUE-17 serving_fleet block: failover latency and
+        dropped/shed streams grade lower, the replica-loss throughput
+        ratio and the goodput delta grade higher, the resume count is
+        workload shape."""
+        base = "serving_fleet"
+        assert bc.classify(f"{base}.ok") == "exact_higher"
+        assert bc.classify(f"{base}.failover_latency_s") == "lower"
+        assert bc.classify(f"{base}.dropped_streams") == "lower"
+        assert bc.classify(f"{base}.throughput_vs_baseline") == "higher"
+        assert bc.classify(f"{base}.goodput_delta") == "higher"
+        assert bc.classify(f"{base}.decode_compiles") == "exact"
+        assert bc.classify(f"{base}.config.kill_step") is None
+        assert bc.classify(f"{base}.resumed") is None
+
+    def test_shed_graded_only_inside_fleet_family(self):
+        """``shed`` is a workload-shape activity count everywhere else
+        (the policy/SLO blocks) but a GRADED loss inside serving_fleet:
+        streams the fleet dropped must trend down."""
+        assert bc.classify("serving_fleet.shed") == "lower"
+        assert bc.classify("serving_slo.policy.policy.shed") is None
+        assert bc.classify("serving_reload.shed") is None
 
     def test_policy_regression_and_improvement_graded(self):
         old = {"serving_slo": {"policy": {"hp_ttft_p99_speedup": 5.0,
@@ -183,6 +217,28 @@ class TestCompare:
         flip = _mutated(**{"serving_reload.ok": False})
         assert _kinds(bc.compare(GOLDEN_OLD, flip))[
             "serving_reload.ok"] == "regression"
+
+    def test_fleet_regressions_flagged(self):
+        worse = _mutated(**{"serving_fleet.failover_latency_s": 0.05,
+                            "serving_fleet.throughput_vs_baseline": 0.5,
+                            "serving_fleet.shed": 2,
+                            "serving_fleet.dropped_streams": 1})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        assert kinds["serving_fleet.failover_latency_s"] == "regression"
+        assert kinds["serving_fleet.throughput_vs_baseline"] == \
+            "regression"
+        # zero-baseline: ANY newly shed or dropped stream is outside
+        # tolerance
+        assert kinds["serving_fleet.shed"] == "regression"
+        assert kinds["serving_fleet.dropped_streams"] == "regression"
+        flip = _mutated(**{"serving_fleet.ok": False})
+        assert _kinds(bc.compare(GOLDEN_OLD, flip))[
+            "serving_fleet.ok"] == "regression"
+        better = _mutated(**{"serving_fleet.failover_latency_s": 0.01,
+                             "serving_fleet.goodput_delta": 0.5})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, better))
+        assert kinds["serving_fleet.failover_latency_s"] == "improvement"
+        assert kinds["serving_fleet.goodput_delta"] == "improvement"
 
     def test_missing_graded_metric_flagged(self):
         new = json.loads(json.dumps(GOLDEN_OLD))

@@ -1,0 +1,597 @@
+"""Fault-tolerant fleet serving: replica router with health checks,
+lossless stream failover, and fleet-scale chaos.
+
+THE acceptance run: a 3-replica fleet under a 2x open-loop overload,
+``KillReplica`` hard-killing a replica mid-stream — every victim
+resumes on a survivor and its final token stream is bit-identical to
+an unperturbed isolated run; zero admitted streams are dropped; the
+failover fleet's goodput strictly beats a no-failover fleet on the
+same workload with the same chaos schedule.  The tp=2 variant pins the
+same contract token-identically (psum drift is argmax-tier).
+
+A router of one replica is the identity: the LoadGenerator result over
+``FleetRouter({"r0": sched})`` equals the result over ``sched``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import _logging, obs
+from apex_tpu import serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+from apex_tpu.resilience.fault_injection import (
+    KillReplica,
+    SlowReplica,
+    WedgeReplica,
+)
+from apex_tpu.serving.engine import TPConfig
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256)
+MAX = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def _fleet_mod(model, params):
+    """Three independent 2-slot dense engines — the fleet.  Module
+    -scoped: every jit family compiles once per engine (~seconds each
+    on CPU), so tests share them and reset between."""
+    return tuple(sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                                 prefill_len=32) for _ in range(3))
+
+
+@pytest.fixture
+def fleet_engines(_fleet_mod):
+    for e in _fleet_mod:
+        e.reset()
+    return _fleet_mod
+
+
+@pytest.fixture(scope="module")
+def _ref_mod(model, params):
+    return sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=32)
+
+
+@pytest.fixture(scope="module")
+def isolated_tokens(_ref_mod):
+    """``fn(request) -> tokens``: the request's stream run alone on a
+    FIFO scheduler — the unperturbed reference every failover survivor
+    must match bit for bit."""
+    eng = _ref_mod
+    memo = {}
+
+    def run(request):
+        key = (tuple(request.prompt), request.max_new_tokens,
+               request.eos_id, request.temperature, request.top_k,
+               request.seed)
+        if key not in memo:
+            eng.reset()
+            sched = sv.ContinuousBatchingScheduler(eng, max_queue=4)
+            sched.submit(sv.Request("ref", request.prompt,
+                                    max_new_tokens=request.max_new_tokens,
+                                    eos_id=request.eos_id,
+                                    temperature=request.temperature,
+                                    top_k=request.top_k,
+                                    seed=request.seed))
+            memo[key] = sched.run()["ref"].tokens
+        return memo[key]
+
+    return run
+
+
+def _prompt(seed, n=8):
+    return [int(x)
+            for x in np.random.default_rng(seed).integers(0, 128, n)]
+
+
+def _mk_fleet(engines, clk, *, max_queue=8, prefix=False, config=None):
+    scheds = {
+        f"r{i}": sv.ContinuousBatchingScheduler(
+            e, max_queue=max_queue, log_interval=10 ** 9, clock=clk,
+            prefix_caching=(sv.PrefixCacheConfig() if prefix else None))
+        for i, e in enumerate(engines)}
+    return sv.FleetRouter(scheds,
+                          config=config if config is not None
+                          else sv.FleetConfig())
+
+
+class _EventTap:
+    def __init__(self):
+        self.events = []
+
+    def __enter__(self):
+        self._sink = lambda e: self.events.append(dict(e))
+        _logging.add_event_sink(self._sink)
+        return self
+
+    def __exit__(self, *exc):
+        _logging.remove_event_sink(self._sink)
+
+    def of(self, kind):
+        return [e for e in self.events if e.get("event") == kind]
+
+
+# ---------------------------------------------------------------------------
+# router units: construction, identity, placement
+# ---------------------------------------------------------------------------
+
+
+class TestFleetUnits:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="suspect_after_s"):
+            sv.FleetConfig(suspect_after_s=0.0)
+        with pytest.raises(ValueError, match="must exceed"):
+            sv.FleetConfig(suspect_after_s=2.0, dead_after_s=1.0)
+
+    def test_router_validation(self, fleet_engines):
+        e0, e1, _ = fleet_engines
+        clk = sv.VirtualClock()
+        with pytest.raises(ValueError, match="at least one"):
+            sv.FleetRouter({})
+        s0 = sv.ContinuousBatchingScheduler(e0, clock=clk)
+        s_other_clock = sv.ContinuousBatchingScheduler(
+            e1, clock=sv.VirtualClock())
+        with pytest.raises(ValueError, match="share the fleet clock"):
+            sv.FleetRouter({"a": s0, "b": s_other_clock})
+        s_same_engine = sv.ContinuousBatchingScheduler(e0, clock=clk)
+        with pytest.raises(ValueError, match="shares an engine"):
+            sv.FleetRouter({"a": s0, "b": s_same_engine})
+        with pytest.raises(ValueError, match="unknown replicas"):
+            sv.FleetRouter(
+                {"a": s0},
+                config=sv.FleetConfig(weights={"zz": 2.0}))
+
+    def test_router_of_one_is_identity(self, fleet_engines):
+        """Satellite: the LoadGenerator drives any submit/step/results
+        target — a fleet of one replica reproduces the bare
+        scheduler's run exactly (same tokens, same completions, same
+        goodput, same step count), and the workload fingerprint the
+        bench keys on is untouched by the wrapping."""
+        e0 = fleet_engines[0]
+        prompts = [_prompt(i) for i in range(5)]
+        wl = sv.make_workload(prompts, sv.uniform_arrivals(5, 4.0),
+                              max_new_tokens=4, deadline_s=30.0)
+        fp = wl.schedule_fingerprint()
+
+        def one_run(wrap):
+            e0.reset()
+            clk = sv.VirtualClock()
+            sched = sv.ContinuousBatchingScheduler(
+                e0, max_queue=8, log_interval=10 ** 9, clock=clk)
+            target = sv.FleetRouter({"r0": sched}) if wrap else sched
+            return sv.LoadGenerator(target, wl, step_time_s=0.25).run()
+
+        bare = one_run(wrap=False)
+        fleet = one_run(wrap=True)
+        assert wl.schedule_fingerprint() == fp
+        assert {r: v.tokens for r, v in fleet.results.items()} \
+            == {r: v.tokens for r, v in bare.results.items()}
+        assert {r: v.finish_reason for r, v in fleet.results.items()} \
+            == {r: v.finish_reason for r, v in bare.results.items()}
+        assert fleet.rejected == bare.rejected
+        assert fleet.completed == bare.completed
+        assert fleet.goodput == bare.goodput
+        assert fleet.steps == bare.steps
+
+    def test_prefix_affinity_placement_probes_read_only(
+            self, fleet_engines, isolated_tokens):
+        """A shared-prefix request routes to the replica whose cache
+        covers its prompt, and the placement probe never pollutes any
+        replica's hit/miss accounting (READ-ONLY probe, not a
+        lookup)."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk, prefix=True)
+        shared = _prompt(7, n=40)
+        warm = sv.Request("warm", shared, max_new_tokens=2)
+        router.submit(warm)
+        home = router.placement_of("warm")
+        assert home is not None
+        router.run()
+        assert router.replica(home).prefix_cache.stats()["entries"] > 0
+        stats_before = {n: router.replica(n).prefix_cache.stats()
+                        for n in router.replica_names}
+        hit = sv.Request("hit", shared + [3, 5], max_new_tokens=2)
+        router.submit(hit)
+        # affinity won over WRR: the request landed on the warm replica
+        assert router.placement_of("hit") == home
+        # ...and choosing it read no cache: stats byte-identical
+        assert {n: router.replica(n).prefix_cache.stats()
+                for n in router.replica_names} == stats_before
+        out = router.run()
+        assert out["hit"].tokens == isolated_tokens(hit)
+
+    def test_wrr_weights_spread_placements(self, fleet_engines):
+        """With no cache coverage anywhere, smooth WRR places by
+        weight: 2:1:1 over 8 submissions lands 4/2/2."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(
+            fleet_engines, clk,
+            config=sv.FleetConfig(
+                weights={"r0": 2.0, "r1": 1.0, "r2": 1.0}))
+        for i in range(8):
+            router.submit(sv.Request(f"w{i}", _prompt(20 + i),
+                                     max_new_tokens=1))
+        counts = {"r0": 0, "r1": 0, "r2": 0}
+        for i in range(8):
+            counts[router.placement_of(f"w{i}")] += 1
+        assert counts == {"r0": 4, "r1": 2, "r2": 2}
+
+    def test_queue_full_retries_next_best_then_sheds(self, fleet_engines):
+        """A replica's QueueFull moves the submission to the next-best
+        candidate; when every healthy replica refuses, the router
+        sheds with a fleet event and re-raises QueueFull for the
+        open-loop loadgen."""
+        clk = sv.VirtualClock()
+        # weight r0 so heavily every submission tries it first — its
+        # 1-deep queue forces the deterministic retry path
+        router = _mk_fleet(
+            fleet_engines, clk, max_queue=1,
+            config=sv.FleetConfig(weights={"r0": 100.0}))
+        with _EventTap() as tap:
+            for i in range(3):
+                router.submit(sv.Request(f"q{i}", _prompt(30 + i),
+                                         max_new_tokens=1))
+            with pytest.raises(sv.QueueFull, match="every healthy"):
+                router.submit(sv.Request("q3", _prompt(33),
+                                         max_new_tokens=1))
+        routed = tap.of("serving_fleet_routed")
+        assert [e["rid"] for e in routed] == ["q0", "q1", "q2"]
+        assert routed[0]["retries"] == 0         # r0 had room
+        assert routed[1]["retries"] >= 1         # r0 full: moved on
+        shed = tap.of("serving_fleet_shed")
+        assert [e["rid"] for e in shed] == ["q3"]
+        assert shed[0]["reason"] == "all_full"
+        assert router.fleet_stats["shed"] == 1
+
+    def test_replica_reports_partition_by_final_placement(
+            self, fleet_engines):
+        """Per-replica SLO reports split the request-trace records by
+        who FINISHED each stream; the fleet entry aggregates them."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        wl = sv.make_workload(
+            [_prompt(70 + i) for i in range(6)],
+            sv.uniform_arrivals(6, 12.0), max_new_tokens=3,
+            deadline_s=30.0, rid_prefix="rr")
+        with obs.recording_requests(clock=clk) as rec:
+            out = sv.LoadGenerator(router, wl,
+                                   step_time_s=0.25).run()
+        assert out.completed == 6
+        reports = router.replica_reports(
+            rec.records(), deadlines=out.deadlines,
+            arrivals=out.arrivals, duration_s=out.duration_s)
+        assert "fleet" in reports
+        fleet = reports["fleet"]
+        assert fleet.completed == 6 and fleet.goodput == 1.0
+        per_replica = {k: v for k, v in reports.items() if k != "fleet"}
+        assert sum(r.completed for r in per_replica.values()) == 6
+        for name, rep in per_replica.items():
+            served = [rid for rid in out.results
+                      if router.placement_of(rid) == name]
+            assert rep.completed == len(served) > 0
+
+
+# ---------------------------------------------------------------------------
+# health state machine + failover fidelities
+# ---------------------------------------------------------------------------
+
+
+class TestFleetHealth:
+    def test_straggler_goes_suspect_then_recovers(self, fleet_engines):
+        """SlowReplica: missed beats past suspect_after_s drive
+        HEALTHY→SUSPECT (no new placements), and the next completed
+        beat recovers HEALTHY with WRR credits reset."""
+        clk = sv.VirtualClock()
+        # the straggler's clock inflation ages EVERY replica's last
+        # beat (one shared timeline), so the suspect threshold sits
+        # between the healthy inter-beat gap (0.5s on stalled steps)
+        # and the straggler's two-missed-beats age (1.0s)
+        router = _mk_fleet(
+            fleet_engines, clk,
+            config=sv.FleetConfig(suspect_after_s=0.75,
+                                  dead_after_s=5.0))
+        fault = SlowReplica("r1", steps=[0, 1], extra_s=0.25, clock=clk)
+        with _EventTap() as tap:
+            for step in range(4):
+                router.step()
+                fault(step, router)
+                clk.advance(0.25)
+                if router.state_of("r1") is sv.ReplicaState.SUSPECT:
+                    # a suspect replica takes no new placements
+                    router.submit(sv.Request(f"s{step}", _prompt(40),
+                                             max_new_tokens=1))
+                    assert router.placement_of(f"s{step}") != "r1"
+        trans = [(e["replica"], e["state"])
+                 for e in tap.of("serving_fleet_replica_state")]
+        assert trans == [("r1", "suspect"), ("r1", "healthy")]
+        assert router.state_of("r1") is sv.ReplicaState.HEALTHY
+        assert router.replicas_healthy == 3
+
+    def test_wedge_watchdog_death_resumes_mid_stream_bit_exact(
+            self, fleet_engines, isolated_tokens):
+        """WedgeReplica: the hung replica stops beating, the watchdog
+        walks it SUSPECT→DEAD on the shared clock, and its mid-decode
+        stream moves to a survivor by capture-resume — the served
+        tokens are bit-identical to an unperturbed isolated run and
+        the stream finishes `preempted-resumed` (full service)."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(
+            fleet_engines, clk,
+            config=sv.FleetConfig(suspect_after_s=0.5, dead_after_s=1.1))
+        victim = sv.Request("v", _prompt(50), max_new_tokens=8)
+        router.submit(victim)
+        home = router.placement_of("v")
+        for _ in range(3):                      # prefill + first decodes
+            router.step()
+            clk.advance(0.25)
+        assert router.replica(home).phase_of("v").value == "decode"
+        fault = WedgeReplica(home, at_step=0)
+        with _EventTap() as tap:
+            fault(0, router)
+            for _ in range(8):
+                router.step()
+                clk.advance(0.25)
+            results = router.run()
+        assert fault.wedged
+        assert router.state_of(home) is sv.ReplicaState.DEAD
+        assert router.replicas_healthy == 2
+        fo = tap.of("serving_fleet_failover")
+        assert [(e["rid"], e["mode"]) for e in fo] \
+            == [("v", "capture-resume")]
+        assert fo[0]["new_tokens"] > 0          # tokens moved, not redone
+        rs = tap.of("serving_fleet_resumed")
+        assert [(e["rid"], e["mode"]) for e in rs] \
+            == [("v", "capture-resume")]
+        assert rs[0]["replica"] != home
+        assert results["v"].finish_reason == "preempted-resumed"
+        assert results["v"].tokens == isolated_tokens(victim)
+        assert router.fleet_stats["resumed"] == 1
+
+    def test_kill_requeues_and_replays_deterministically(
+            self, fleet_engines, isolated_tokens):
+        """A hard kill loses the device cache: the victim re-queues
+        bare on a survivor and replays — the final token stream is
+        still bit-identical to an uninterrupted run."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        victim = sv.Request("k", _prompt(60), max_new_tokens=6)
+        router.submit(victim)
+        home = router.placement_of("k")
+        for _ in range(3):
+            router.step()
+            clk.advance(0.25)
+        with _EventTap() as tap:
+            router.kill(home)
+            router.kill(home)                   # idempotent on DEAD
+            results = router.run()
+        fo = tap.of("serving_fleet_failover")
+        assert [(e["rid"], e["mode"]) for e in fo] == [("k", "requeue")]
+        assert results["k"].finish_reason == "length"
+        assert results["k"].tokens == isolated_tokens(victim)
+        assert router.state_of(home) is sv.ReplicaState.DEAD
+        # the dead scheduler was closed; a rebuilt one replaces it
+        fresh = sv.ContinuousBatchingScheduler(
+            router.replica(home).engine, max_queue=8,
+            log_interval=10 ** 9, clock=clk)
+        with pytest.raises(ValueError, match="replace"):
+            router.rejoin(home)
+        router.replace(home, fresh)
+        assert router.state_of(home) is sv.ReplicaState.HEALTHY
+        assert router.replicas_healthy == 3
+
+    def test_drain_moves_streams_then_rejoin(self, fleet_engines,
+                                             isolated_tokens):
+        """The rolling-reload hook: drain() moves a replica's live
+        streams to survivors (capture-resume on dense), leaves it open
+        and empty for an idle reload, and rejoin() returns it to
+        placement eligibility."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reqs = [sv.Request(f"d{i}", _prompt(70 + i), max_new_tokens=6)
+                for i in range(2)]
+        for r in reqs:
+            router.submit(r)
+        for _ in range(3):
+            router.step()
+            clk.advance(0.25)
+        target = router.placement_of("d0")
+        moved = router.drain(target)
+        assert "d0" in moved
+        assert router.state_of(target) is sv.ReplicaState.DRAINING
+        assert router.replica(target).active_count == 0
+        assert router.replica(target).queue_depth == 0
+        # a draining replica takes no new placements
+        router.submit(sv.Request("after", _prompt(79), max_new_tokens=2))
+        assert router.placement_of("after") != target
+        results = router.run()
+        for r in reqs + [sv.Request("after", _prompt(79),
+                                    max_new_tokens=2)]:
+            assert results[r.rid].tokens == isolated_tokens(r)
+        router.rejoin(target)
+        assert router.state_of(target) is sv.ReplicaState.HEALTHY
+        with pytest.raises(ValueError, match="no other healthy"):
+            # draining every peer first would strand the streams
+            for name in router.replica_names:
+                router.drain(name)
+
+
+# ---------------------------------------------------------------------------
+# paged fleet teardown: a killed replica never leaks pins or blocks
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_kill_releases_paged_blocks_and_pins(model, params,
+                                                   isolated_tokens):
+    """Fleet extension of the scheduler close() pin-leak regression: a
+    killed *paged* replica's export + close derefs every cached pool
+    block and unhooks the reclaim callback — nothing pins the dead
+    pool — and the victim stream (paged capture cannot cross engines)
+    re-queues on the survivor and replays bit-identically."""
+    def paged_engine():
+        return sv.DecodeEngine(
+            model, params, slots=2, max_len=MAX, prefill_len=32,
+            paged=sv.PagedCacheConfig(block_size=16, num_blocks=24))
+
+    e0, e1 = paged_engine(), paged_engine()
+    clk = sv.VirtualClock()
+    router = _mk_fleet((e0, e1), clk, prefix=True)
+    prompt = _prompt(80, n=40)
+    warm = sv.Request("warm", prompt, max_new_tokens=2)
+    router.submit(warm)
+    home = router.placement_of("warm")
+    router.run()
+    eng = router.replica(home).engine
+    assert eng.block_pool.used_blocks > 0       # cache holds pool refs
+    assert eng.block_pool.reclaim is not None
+    victim = sv.Request("vic", prompt, max_new_tokens=4)
+    router.submit(victim)
+    assert router.placement_of("vic") == home   # affinity
+    for _ in range(2):
+        router.step()
+        clk.advance(0.25)
+    with _EventTap() as tap:
+        router.kill(home)
+        results = router.run()
+    # the dead replica's pool: every block released, reclaim unhooked
+    assert eng.block_pool.used_blocks == 0
+    assert eng.block_pool.reclaim is None
+    # paged failover is always requeue (block bytes cannot cross pools)
+    fo = tap.of("serving_fleet_failover")
+    assert [(e["rid"], e["mode"]) for e in fo] == [("vic", "requeue")]
+    assert results["vic"].tokens == isolated_tokens(victim)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: fleet chaos under overload
+# ---------------------------------------------------------------------------
+
+
+class TestFleetChaosAcceptance:
+    N = 12
+    KILL_STEP = 6
+
+    def _workload(self):
+        prompts = [_prompt(100 + i) for i in range(self.N)]
+        # ~2x overload: all 12 arrive inside 1.5s of virtual time while
+        # the 3x2-slot fleet needs several times that to serve them
+        return sv.make_workload(prompts,
+                                sv.uniform_arrivals(self.N, 8.0),
+                                max_new_tokens=5, deadline_s=60.0,
+                                rid_prefix="fl")
+
+    def _run(self, engines, *, failover):
+        for e in engines:
+            e.reset()
+        clk = sv.VirtualClock()
+        scheds = {
+            f"r{i}": sv.ContinuousBatchingScheduler(
+                e, max_queue=8, log_interval=10 ** 9, clock=clk)
+            for i, e in enumerate(engines)}
+        router = sv.FleetRouter(
+            scheds, config=sv.FleetConfig(failover=failover))
+        fault = KillReplica("r0", at_step=self.KILL_STEP)
+        wl = self._workload()
+        with _EventTap() as tap:
+            out = sv.LoadGenerator(router, wl, step_time_s=0.25,
+                                   step_hook=fault).run()
+        assert fault.killed
+        return router, out, tap
+
+    def test_kill_mid_stream_under_overload(self, fleet_engines,
+                                            isolated_tokens):
+        """Kill a replica mid-stream under 2x overload: every victim
+        resumes on a survivor, zero admitted streams drop, every final
+        token stream is bit-identical to its unperturbed isolated run,
+        and fleet goodput strictly beats the no-failover fleet on the
+        same chaos schedule."""
+        obs.metrics.reset()
+        wl = self._workload()
+        router, out, tap = self._run(fleet_engines, failover=True)
+        victims = {e["rid"] for e in tap.of("serving_fleet_failover")}
+        assert victims                           # the kill hit live work
+        # zero dropped: nothing rejected at submit, and every offered
+        # request finished with FULL service
+        assert out.rejected == []
+        assert set(out.results) == {r.rid for r in wl.requests}
+        for rid, res in out.results.items():
+            assert res.finish_reason in sv.SERVED_REASONS, \
+                f"{rid} dropped: {res.finish_reason}"
+        # bit-exactness: every stream — victims included — matches its
+        # unperturbed isolated reference
+        for req in wl.requests:
+            assert out.results[req.rid].tokens == isolated_tokens(req), \
+                f"{req.rid} diverged after failover"
+        assert router.replicas_healthy == 2
+        g_failover = out.goodput
+        assert g_failover is not None
+        # the metrics surfaced: gauge tracks survivors, counters moved
+        snap = obs.snapshot()
+        healthy = snap["apex_serving_fleet_replicas_healthy"]["series"]
+        assert healthy and healthy[0]["value"] == 2
+        routed = snap["apex_serving_fleet_routed_total"]["series"]
+        assert sum(s["value"] for s in routed) >= self.N
+        fo_secs = snap["apex_serving_fleet_failover_seconds"]["series"]
+        assert fo_secs and fo_secs[0]["count"] >= 1
+        # no new program family on the failover path: decode compiled
+        # exactly once per engine, the contract everywhere else
+        for e in fleet_engines:
+            assert e.decode_compiles() == 1
+
+        # the honesty baseline: same workload, same chaos, no failover
+        _, out0, tap0 = self._run(fleet_engines, failover=False)
+        shed0 = {e["rid"] for e in tap0.of("serving_fleet_shed")}
+        assert shed0                             # victims were dropped
+        for rid in shed0:
+            res = out0.results.get(rid)
+            assert res is None or res.finish_reason \
+                not in sv.SERVED_REASONS
+        g_none = out0.goodput
+        assert g_none is not None
+        assert g_failover >= g_none + 0.1, \
+            f"failover goodput {g_failover} vs no-failover {g_none}"
+
+    def test_kill_mid_stream_tp2_token_identical(self, model, params,
+                                                 isolated_tokens):
+        """The tp=2 variant: a 2-replica tp fleet loses one replica
+        mid-stream; the victim replays on the survivor and the served
+        stream is token-identical to the single-chip isolated run (the
+        documented ~2.5e-7 psum drift is argmax-tier — it never moves
+        a greedy token)."""
+        engines = tuple(
+            sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                            prefill_len=32, tp=TPConfig(size=2))
+            for _ in range(2))
+        clk = sv.VirtualClock()
+        router = _mk_fleet(engines, clk, max_queue=8)
+        reqs = [sv.Request(f"t{i}", _prompt(120 + i), max_new_tokens=5)
+                for i in range(4)]
+        for r in reqs:
+            router.submit(r)
+        for _ in range(3):
+            router.step()
+            clk.advance(0.25)
+        victim_home = router.placement_of("t0")
+        with _EventTap() as tap:
+            router.kill(victim_home)
+            results = router.run()
+        assert tap.of("serving_fleet_failover")
+        for r in reqs:
+            assert results[r.rid].finish_reason in sv.SERVED_REASONS
+            assert results[r.rid].tokens == isolated_tokens(r), \
+                f"{r.rid} diverged from the single-chip reference"
+        for e in engines:
+            assert e.decode_compiles() == 1

@@ -518,6 +518,91 @@ class TestHotReloader:
         assert tap.of("retry_attempt") == []     # no retries burned
 
 
+class TestPrefetch:
+    """Restore-ahead staging (ISSUE 17 satellite): ``prefetch()`` pays
+    restore+validate off the serving path so the boundary ``reload()``
+    is swap-only."""
+
+    def _reloader(self, model, params, tmp_path, *steps):
+        _save_versions(tmp_path, params, *steps)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=steps[0])
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path),
+                            like={"params": params},
+                            params_key="params",
+                            current_step=steps[0])
+        return eng, rl
+
+    def test_prefetch_stages_and_reload_consumes_without_reading_disk(
+            self, model, params, tmp_path):
+        eng, rl = self._reloader(model, params, tmp_path, 100, 200)
+        assert rl.staged_step is None
+        assert rl.prefetch() == 200          # watcher-resolved target
+        assert rl.staged_step == 200
+        assert rl.stats["prefetches"] == 1
+        assert rl.prefetch(step=200) == 200  # idempotent: no re-restore
+        assert rl.stats["prefetches"] == 1
+        # the staged buffer IS the candidate: corrupt the on-disk dir
+        # after staging — a reload that consumed the stage cannot have
+        # re-read it
+        FaultInjector(FaultPlan(seed=3)).corrupt_checkpoint(
+            os.path.join(str(tmp_path), _ckpt._step_dirname(200)))
+        with _EventTap() as tap:
+            out = rl.reload(step=200)
+        assert out.ok and out.step == 200
+        assert rl.staged_step is None        # stage consumed
+        assert _tree_bytes_equal(eng.params, _mutated(params, 0.2))
+        (ev,) = tap.of("serving_weights_swapped")
+        assert ev["prefetched"] is True
+        # the staged phase walls ride along (the work was real, it
+        # just didn't stall serving)
+        assert ev["restore_s"] > 0 and out.restore_s > 0
+
+    def test_stale_stage_discarded_on_mismatched_target(
+            self, model, params, tmp_path):
+        eng, rl = self._reloader(model, params, tmp_path, 100, 200, 300)
+        assert rl.prefetch(step=200) == 200
+        with _EventTap() as tap:
+            out = rl.reload(step=300)        # not what was staged
+        assert out.ok and out.step == 300
+        assert rl.staged_step is None        # stale stage dropped
+        assert _tree_bytes_equal(eng.params, _mutated(params, 0.3))
+        (ev,) = tap.of("serving_weights_swapped")
+        assert ev["prefetched"] is False
+
+    def test_prefetch_failure_is_none_not_a_refusal(
+            self, model, params, tmp_path):
+        eng, rl = self._reloader(model, params, tmp_path, 100, 200)
+        FaultInjector(FaultPlan(seed=4)).corrupt_checkpoint(
+            os.path.join(str(tmp_path), _ckpt._step_dirname(200)))
+        with _EventTap() as tap:
+            assert rl.prefetch(step=200) is None
+        assert rl.staged_step is None
+        assert rl.stats["prefetches"] == 0
+        # nothing was offered for serving, so no first-class refusal —
+        # the later reload() walks the full path and refuses there
+        assert rl.stats["refusals"] == 0
+        assert tap.of("serving_reload_failed") == []
+        assert not rl.reload(step=200).ok
+        assert rl.stats["refusals"] == 1
+
+    def test_prefetch_no_committed_step_is_none(self, model, params,
+                                                tmp_path):
+        _save_versions(tmp_path, params, 100)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params")
+        eng = _engine(model, boot, slots=2)
+        empty = str(tmp_path / "empty")
+        os.makedirs(empty)
+        rl = sv.HotReloader(_sched(eng), empty,
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        assert rl.prefetch() is None
+        assert rl.staged_step is None
+
+
 # ---------------------------------------------------------------------------
 # THE acceptance run: reload mid-stream under bursty open-loop load
 # ---------------------------------------------------------------------------

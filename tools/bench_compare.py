@@ -144,6 +144,12 @@ def classify(path: str) -> Optional[str]:
                 for s in path.lower().split(".")]
     if any(s in seg for s in _INFORMATIONAL for seg in segments):
         return None
+    # family-scoped override: inside the serving_fleet block, "shed"
+    # is a GRADED outcome (streams the fleet dropped — must trend
+    # down), not the workload-shape activity count it is in the
+    # policy/SLO blocks
+    if "serving_fleet" in segments and segments[-1] == "shed":
+        return "lower"
     if segments[-1] in _INFORMATIONAL_EXACT:
         return None
     for seg in reversed(segments):

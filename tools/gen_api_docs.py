@@ -97,6 +97,7 @@ PAGES = {
         "apex_tpu.serving.loadgen",
         "apex_tpu.serving.weights",
         "apex_tpu.serving.reload",
+        "apex_tpu.serving.fleet",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
         "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
@@ -1001,6 +1002,14 @@ compiles — tier-1 pins it).
   resume a new-weights stream.  The same-spec contract means every
   compiled program family re-dispatches unchanged: a swap adds zero
   compiles.
+- **`HotReloader.prefetch()`** (restore-ahead): stage the next
+  candidate — restore + validate into a side buffer — at any time,
+  off the serving path; the later step-boundary `reload()` whose
+  target matches the staged step consumes the stage and pays only the
+  pointer swap (~1 ms instead of a restore-dominated pause).  A stale
+  stage (target moved on) is discarded and the full path runs; a
+  failed prefetch stages nothing and is not a refusal — nothing was
+  offered for serving.
 - **`HotReloader.rollback()`**: the displaced buffer is retained (one
   previous version), and rollback swaps it back through the identical
   mechanism — prefix-cache invalidation included, bit-exact to the
@@ -1025,7 +1034,68 @@ writer crash racing the watcher, and a reload storm under 2x overload
 — every perturbation must leave the engine serving the last-good
 weights with all streams intact.  `bench.py`'s `serving_reload` block
 measures the swap pause (p99 step-time inflation during reload vs
-steady state), reload wall time, and the A/B mirror overhead.
+steady state), reload wall time, the restore-ahead contrast, and the
+A/B mirror overhead.
+
+## Fault-tolerant fleet serving (`serving.fleet`)
+
+`FleetRouter` fronts N scheduler+engine replicas behind the scheduler
+surface `LoadGenerator` already drives (`submit` / `step` / `run` /
+`results` / `clock`), so one workload serves a fleet unchanged — and
+a fleet of one is **byte-for-byte** the bare scheduler (same tokens,
+same `schedule_fingerprint`, tier-1-pinned).
+
+- **Placement**: prefix-affinity first — each prefix-caching
+  replica's cache is probed **read-only** (`PrefixCache.probe`; a
+  placement decision must never mutate hit/miss/LRU state) and the
+  deepest coverage wins; ties and cold prompts fall back to
+  smooth-weighted-round-robin over the healthy replicas
+  (`FleetConfig(weights=...)`).  A full replica (`QueueFull`) is
+  retried against the next-best candidate; only when every healthy
+  queue refuses does the router shed.
+- **Health**: a completed replica step is a heartbeat on the fleet's
+  one shared clock.  Beat age ≥ `suspect_after_s` ⇒ SUSPECT (takes no
+  new placements, keeps serving); ≥ `dead_after_s` ⇒ DEAD, and the
+  watchdog drains the replica via preempt-capture.  A completed beat
+  while SUSPECT recovers to HEALTHY with WRR credits reset (a
+  returning replica must not be flooded by its accumulated deficit).
+- **Failover fidelity is tiered and honest**: a watchdog-detected
+  death (host state intact) captures live DECODE streams — cache
+  bytes travel, and the stream resumes on a survivor **bit-exactly**
+  (`finish_reason="preempted-resumed"`).  A hard `kill()` (device
+  memory lost) re-queues victims from their host-side request
+  records with their ORIGINAL submit time; deterministic sampling
+  (explicit keys folded per token index) makes the replay
+  token-identical for greedy and seeded-temperature streams.
+  Captured bytes cannot cross into a paged engine (block references
+  are pool-local), so a mixed fleet degrades such victims to replay
+  rather than deadlock.  Priority classes survive first; with
+  `failover=False` victims are shed — the measured contrast is the
+  machinery's value.
+- **Ops**: `drain(name)` (rolling reload: move streams off, replica
+  stays open and empty), `rejoin(name)` after drain/recovery,
+  `replace(name, sched)` for a dead replica rebuilt on a fresh
+  scheduler.  A killed or closed replica releases its prefix-cache
+  pins and paged-pool holds (`scheduler.close()`) — fleet teardown
+  leaks nothing (the pin-leak regression covers it).
+- **Chaos**: `resilience.fault_injection` grows `KillReplica` /
+  `WedgeReplica` / `SlowReplica`, wired through the same
+  `LoadGenerator(step_hook=)` as every other serving fault.  The
+  acceptance run kills a replica mid-stream under 2x overload and
+  requires victims token-identical to an unperturbed isolated run
+  and strictly better goodput than the same chaos without failover.
+
+Observability: `apex_serving_fleet_replicas_healthy`,
+`..._routed_total{replica}`, `..._transitions_total{state}`,
+`..._failovers_total{mode}`, `..._resumes_total`, `..._shed_total`,
+and `..._failover_seconds` (failure → survivor landing, per stream).
+`FleetRouter.replica_reports(records)` splits a
+`recording_requests` run into per-replica `SLOReport`s (a failover
+victim reports on the survivor that finished it) plus the fleet
+aggregate.
+`bench.py`'s `serving_fleet` block records the failover latency, the
+replica-loss throughput ratio, and the failover-on vs -off goodput
+delta on identical chaos.
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -1104,7 +1174,14 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_tp_size` | gauge | `serving_tp_step` events (tensor-parallel mesh width the decode programs run over; 1 == single-chip) |
 | `apex_serving_collective_seconds` | histogram | `serving_tp_step` events (tp decode step wall time, dispatch → completion — an upper bound on per-step collective cost) |
 | `apex_serving_weights_step` | gauge | `serving_weights_loaded` / `serving_weights_swapped` events (training step of the weights currently serving — boot load, hot swap, and rollback all set it) |
-| `apex_serving_reload_duration_seconds{phase}` | histogram | `serving_weights_loaded` (phase=`restore`) and `serving_weights_swapped` (phase=`validate`\|`swap`) events — hot-reload phase wall time; `swap` is the only phase the serving loop waits on |
+| `apex_serving_reload_duration_seconds{phase}` | histogram | `serving_weights_loaded` (phase=`restore`) and `serving_weights_swapped` (phase=`validate`\\|`swap`) events — hot-reload phase wall time; `swap` is the only phase the serving loop waits on |
+| `apex_serving_fleet_replicas_healthy` | gauge | fleet router step (replicas currently HEALTHY; suspect/draining/dead do not count) |
+| `apex_serving_fleet_routed_total{replica}` | counter | `serving_fleet_routed` events — placements by the fleet router (affinity or WRR; label cardinality bounded by fleet size) |
+| `apex_serving_fleet_transitions_total{state}` | counter | `serving_fleet_replica_state` events — health transitions by destination state |
+| `apex_serving_fleet_failovers_total{mode}` | counter | `serving_fleet_failover` events — streams evacuated from a dead/draining replica (mode=`capture-resume`\\|`requeue`) |
+| `apex_serving_fleet_resumes_total` | counter | `serving_fleet_resumed` events with mode=`capture-resume` — victims landed on a survivor with captured cache intact (bit-exact mid-stream) |
+| `apex_serving_fleet_shed_total` | counter | `serving_fleet_shed` events — requests the fleet shed (all healthy queues full, no replica, or unabsorbed failover victims) |
+| `apex_serving_fleet_failover_seconds` | histogram | `serving_fleet_resumed` events — replica failure (or drain) to survivor landing, per stream, on the fleet's shared clock |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -1751,7 +1828,55 @@ weights; a swap adds **zero** new compiles (same-spec contract).  The
 step being served rides `apex_serving_weights_step`, phase timings
 ride `apex_serving_reload_duration_seconds{phase}`, and `bench.py`'s
 `serving_reload` block records the honest swap pause (p99 step-time
-inflation during a mid-traffic reload) in `PERF_NOTES.md`.
+inflation during a mid-traffic reload) in `PERF_NOTES.md`.  Call
+`reloader.prefetch()` whenever the server is idle and the restore is
+paid off the serving path — the boundary `reload()` consumes the
+staged candidate and the pause drops to the pointer swap alone.
+
+Survive a replica crash without dropping a stream — one engine is one
+blast radius; a fleet router in front of N replicas turns a replica
+death into a per-stream failover instead of N×slots dropped requests
+([full page](api/serving.md)):
+
+```python
+from apex_tpu import serving as sv
+
+replicas = {f"r{i}": sv.ContinuousBatchingScheduler(
+                engines[i], max_queue=64,
+                prefix_caching=sv.PrefixCacheConfig())
+            for i in range(3)}
+router = sv.FleetRouter(replicas, config=sv.FleetConfig(
+    suspect_after_s=1.0,   # missed beats -> no new placements
+    dead_after_s=3.0,      # -> declared dead, streams evacuated
+    weights={"r0": 2.0}))  # smooth WRR when affinity has no opinion
+
+out = sv.LoadGenerator(router, wl).run()   # the scheduler surface,
+                                           # fleet-wide
+
+router.drain("r1")      # rolling reload: move streams off, replica
+...                     # stays open — reload it idle, then
+router.rejoin("r1")     # WRR credits reset, takes traffic again
+```
+
+Placement is prefix-affinity first (a replica already holding the
+prompt's cached blocks wins — probed read-only, never mutating cache
+state), smooth WRR otherwise, with `QueueFull` retried on the
+next-best replica before anything is shed.  Health is a heartbeat on
+the fleet's shared clock: a wedged replica walks HEALTHY → SUSPECT →
+DEAD and the watchdog evacuates its streams by preempt-capture — a
+victim resumes on a survivor **bit-exactly** mid-stream
+(`finish_reason="preempted-resumed"`); a hard kill re-queues victims
+and deterministic sampling replays them token-identically.  A killed
+replica releases every prefix pin and paged block it held.  Chaos
+rides the same hooks (`KillReplica`, `WedgeReplica`, `SlowReplica`
+from `resilience.fault_injection`); the tier-1 acceptance run kills a
+replica mid-stream under 2x overload and requires token-identical
+victims plus strictly better goodput than the same chaos without
+failover.  The fleet publishes `apex_serving_fleet_*` metrics
+(healthy-replica gauge, per-replica routing, failovers by mode, the
+failure→resume latency histogram); `bench.py`'s `serving_fleet` block
+records the measured failover latency and the failover-on vs -off
+goodput delta in `PERF_NOTES.md`.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

@@ -223,6 +223,44 @@ SERVING_FLEET_FAILOVER_SECONDS = metrics.histogram(
     "apex_serving_fleet_failover_seconds",
     "replica failure (or drain) to the victim stream landing on a "
     "survivor, per stream, on the fleet's shared clock")
+SERVING_ROLLOUT_ACTIVE = metrics.gauge(
+    "apex_serving_rollout_active",
+    "1 while a rolling fleet upgrade is in flight (set at "
+    "serving_rollout_started, cleared at the promoted/halted "
+    "terminal)")
+SERVING_ROLLOUT_REPLICAS_UPGRADED = metrics.counter(
+    "apex_serving_rollout_replicas_upgraded_total",
+    "replicas that completed the drain -> reload -> rejoin upgrade "
+    "during a rolling fleet upgrade")
+SERVING_ROLLOUT_VERDICTS = metrics.counter(
+    "apex_serving_rollout_verdicts_total",
+    "canary gate decisions by verdict (pass promotes the rollout to "
+    "the remaining replicas; fail halts it)", ("verdict",))
+SERVING_ROLLOUT_HALTS = metrics.counter(
+    "apex_serving_rollout_halts_total",
+    "rolling upgrades halted before promotion (gate failure, refused "
+    "candidate, or a replica death mid-rollout)")
+SERVING_ROLLOUT_ROLLBACKS = metrics.counter(
+    "apex_serving_rollout_rollbacks_total",
+    "replicas rolled back byte-exact from their retained previous "
+    "buffer by a halted rolling upgrade")
+SERVING_ROLLOUT_PROMOTIONS = metrics.counter(
+    "apex_serving_rollout_promotions_total",
+    "rolling upgrades that promoted: every replica serving the new "
+    "weights_step with zero dropped streams")
+SERVING_ROLLOUT_SWAP_PAUSE_SECONDS = metrics.histogram(
+    "apex_serving_rollout_swap_pause_seconds",
+    "per-replica serving pause during a rolling upgrade (the reload's "
+    "pointer swap only — the restore/validate ran off-path via "
+    "prefetch)")
+SERVING_ROLLOUT_VERDICT_LATENCY_SECONDS = metrics.histogram(
+    "apex_serving_rollout_verdict_latency_seconds",
+    "canary window open (traffic pinned) to gate verdict, on the "
+    "fleet's shared clock")
+SERVING_ROLLOUT_WALL_SECONDS = metrics.histogram(
+    "apex_serving_rollout_wall_seconds",
+    "rollout start to terminal (promoted or halted+rolled back), on "
+    "the fleet's shared clock")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -405,6 +443,47 @@ def _on_serving_fleet_shed(event: dict) -> None:
     SERVING_FLEET_SHED.inc()
 
 
+def _on_serving_rollout_started(event: dict) -> None:
+    SERVING_ROLLOUT_ACTIVE.set(1)
+
+
+def _on_serving_rollout_replica_upgraded(event: dict) -> None:
+    SERVING_ROLLOUT_REPLICAS_UPGRADED.inc()
+    swap_s = _measurement(event, "swap_s")
+    if swap_s is not None:
+        SERVING_ROLLOUT_SWAP_PAUSE_SECONDS.observe(swap_s)
+
+
+def _on_serving_rollout_canary_verdict(event: dict) -> None:
+    SERVING_ROLLOUT_VERDICTS.inc(
+        verdict=str(event.get("verdict", "unknown")))
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_ROLLOUT_VERDICT_LATENCY_SECONDS.observe(duration_s)
+
+
+def _on_serving_rollout_halted(event: dict) -> None:
+    SERVING_ROLLOUT_HALTS.inc()
+    SERVING_ROLLOUT_ACTIVE.set(0)
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_ROLLOUT_WALL_SECONDS.observe(duration_s)
+
+
+def _on_serving_rollout_rolled_back(event: dict) -> None:
+    replicas = _measurement(event, "replicas")
+    if replicas is not None and replicas >= 1:
+        SERVING_ROLLOUT_ROLLBACKS.inc(replicas)
+
+
+def _on_serving_rollout_promoted(event: dict) -> None:
+    SERVING_ROLLOUT_PROMOTIONS.inc()
+    SERVING_ROLLOUT_ACTIVE.set(0)
+    duration_s = _measurement(event, "duration_s")
+    if duration_s is not None:
+        SERVING_ROLLOUT_WALL_SECONDS.observe(duration_s)
+
+
 _HANDLERS = {
     "retry_attempt": _on_retry_attempt,
     "retry_exhausted": _on_retry_exhausted,
@@ -434,6 +513,13 @@ _HANDLERS = {
     "serving_fleet_failover": _on_serving_fleet_failover,
     "serving_fleet_resumed": _on_serving_fleet_resumed,
     "serving_fleet_shed": _on_serving_fleet_shed,
+    "serving_rollout_started": _on_serving_rollout_started,
+    "serving_rollout_replica_upgraded":
+        _on_serving_rollout_replica_upgraded,
+    "serving_rollout_canary_verdict": _on_serving_rollout_canary_verdict,
+    "serving_rollout_halted": _on_serving_rollout_halted,
+    "serving_rollout_rolled_back": _on_serving_rollout_rolled_back,
+    "serving_rollout_promoted": _on_serving_rollout_promoted,
 }
 
 

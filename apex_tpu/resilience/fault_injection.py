@@ -82,7 +82,8 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from typing import Any, Callable, Iterable, Iterator, Optional, Tuple, Type
+from typing import (Any, Callable, Dict, Iterable, Iterator, Optional,
+                    Tuple, Type)
 
 import jax
 import jax.numpy as jnp
@@ -93,13 +94,16 @@ from apex_tpu._logging import emit_event
 __all__ = [
     "CancelStorm",
     "CorruptBatch",
+    "CorruptCandidateMidRollout",
     "CorruptShardFile",
     "CrashCheckpointWriter",
     "DesyncReplica",
     "FaultInjector",
     "FaultPlan",
     "FlakyIterator",
+    "KillCanary",
     "KillReplica",
+    "RegressingWeights",
     "ReloadStorm",
     "SimulatedPreemption",
     "SimulatedWriterCrash",
@@ -618,6 +622,156 @@ class SlowReplica:
                    extra_s=self.extra_s)
         router.stall(self.replica)
         self._clock.advance(self.extra_s)
+
+
+# -- rollout faults (ISSUE 18) ----------------------------------------------
+
+
+class CorruptCandidateMidRollout:
+    """Flip bytes in the rollout's candidate checkpoint at a chosen
+    loadgen step — the committed-but-rotted candidate a rolling
+    upgrade must refuse.
+
+    ``step_hook`` over a :class:`~apex_tpu.serving.fleet.FleetRouter`
+    run driven by a :class:`~apex_tpu.serving.rollout.
+    RollingReloadController`: at ``at_step`` the candidate's
+    ``data.bin`` gets seed-chosen bytes flipped in place (the
+    :meth:`FaultInjector.corrupt_checkpoint` corruption).  Any replica
+    whose reload restores those bytes refuses first-class (the
+    checksum/validation gate), which the controller turns into
+    automatic halt + fleet rollback.  Fire it *before* the victim
+    wave's prefetch — a stage restored earlier already holds clean
+    bytes (restore-ahead is exactly that window).
+    """
+
+    def __init__(self, root: str, step: int, *, at_step: int,
+                 seed: int = 0, nbytes: int = 8):
+        if at_step < 0:
+            raise ValueError(f"at_step must be >= 0, got {at_step}")
+        self.root = str(root)
+        self.step = int(step)
+        self.at_step = int(at_step)
+        self.seed = int(seed)
+        self.nbytes = int(nbytes)
+        self.corrupted = False
+
+    def __call__(self, step: int, router=None) -> None:
+        if self.corrupted or int(step) != self.at_step:
+            return
+        from apex_tpu.resilience.checkpoint import _step_dirname
+
+        emit_event("fault_injected", fault="corrupt_candidate",
+                   step=int(step), candidate_step=self.step)
+        injector = FaultInjector(FaultPlan(seed=self.seed))
+        injector.corrupt_checkpoint(
+            os.path.join(self.root, _step_dirname(self.step)),
+            nbytes=self.nbytes)
+        self.corrupted = True
+
+
+class RegressingWeights:
+    """A candidate that *validates clean but serves measurably worse*
+    — the regression only a canary gate catches.
+
+    :meth:`publish` commits a spec-valid candidate (same tree,
+    shapes, dtypes — every structural gate passes) whose weights are
+    perturbed.  The serving regression itself is modeled by the hook:
+    on a virtual clock no weight value can slow its own matmul, so
+    *any* replica currently serving the candidate step is stalled
+    every ``slow_every``-th call
+    (:meth:`~apex_tpu.serving.fleet.FleetRouter.stall` — its streams
+    miss that beat), inflating the candidate arm's per-token latency
+    deterministically while old-version replicas run clean.  During a
+    gated rollout only the canary serves the candidate, so only the
+    canary degrades and the gate catches it; with the gate disabled
+    the whole fleet ends up on the candidate and the whole fleet
+    degrades — the goodput contrast the gate exists to buy.  Stalls
+    are phase-offset per replica so a fully-upgraded fleet halves its
+    capacity rather than freezing outright.  Keep ``slow_every *
+    step_time`` under the fleet's ``suspect_after_s`` so the watchdog
+    never escalates — the regression must be caught by the *gate*,
+    not the health check.  The stalling stops on its own when a
+    replica leaves the candidate step (rollback).
+    """
+
+    def __init__(self, controller, *, slow_every: int = 2):
+        if slow_every < 2:
+            raise ValueError(
+                f"slow_every must be >= 2, got {slow_every} — at 1 "
+                f"every step stalls and streams never finish")
+        self.controller = controller
+        self.slow_every = int(slow_every)
+        self.stalls = 0
+        self._announced = False
+        self._ticks: Dict[str, int] = {}
+
+    @staticmethod
+    def publish(root: str, params: Any, step: int, *,
+                delta: float = 1e-3) -> Any:
+        """Commit the degraded-but-valid candidate
+        ``{"params": params + delta}`` at ``step``; returns the
+        perturbed tree (for bit-exactness assertions)."""
+        from apex_tpu.resilience.checkpoint import save_checkpoint
+
+        bad = jax.tree.map(
+            lambda l: (l + jnp.asarray(delta, l.dtype)
+                       if jnp.issubdtype(jnp.asarray(l).dtype,
+                                         jnp.inexact) else l),
+            params)
+        save_checkpoint(str(root), int(step), {"params": bad})
+        return bad
+
+    def __call__(self, step: int, router) -> None:
+        c = self.controller
+        if c.target_step is None:
+            return
+        for idx, name in enumerate(router.replica_names):
+            sched = router.replica(name)
+            if getattr(sched, "weights_step", None) != c.target_step:
+                continue                 # not serving the candidate
+            if not self._announced:
+                emit_event("fault_injected",
+                           fault="regressing_weights", replica=name,
+                           step=int(step),
+                           candidate_step=c.target_step)
+                self._announced = True
+            tick = self._ticks.get(name, 0)
+            self._ticks[name] = tick + 1
+            if (tick + idx) % self.slow_every == 0:
+                self.stalls += 1
+                router.stall(name)
+
+
+class KillCanary:
+    """Kill the canary replica mid-verdict-window (device memory
+    lost) — the rollout must halt and roll back, and the canary's
+    in-flight streams must replay losslessly on the old-version
+    survivors.
+
+    ``step_hook``: once the controller enters its canary window
+    (traffic pinned), waits ``after_window_steps`` window steps, then
+    hard-kills whichever replica the controller chose as canary.
+    """
+
+    def __init__(self, controller, *, after_window_steps: int = 1):
+        if after_window_steps < 1:
+            raise ValueError(f"after_window_steps must be >= 1, got "
+                             f"{after_window_steps}")
+        self.controller = controller
+        self.after = int(after_window_steps)
+        self.killed = False
+        self._seen = 0
+
+    def __call__(self, step: int, router) -> None:
+        if self.killed or self.controller.phase != "canary":
+            return
+        self._seen += 1
+        if self._seen < self.after:
+            return
+        emit_event("fault_injected", fault="kill_canary",
+                   replica=self.controller.canary, step=int(step))
+        router.kill(self.controller.canary)
+        self.killed = True
 
 
 # -- pod-scale faults (PR 3) -----------------------------------------------

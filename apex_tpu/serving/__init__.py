@@ -188,6 +188,12 @@ from apex_tpu.serving.reload import (
     WeightWatcher,
     assign_arm,
 )
+from apex_tpu.serving.rollout import (
+    CanaryGate,
+    CanaryVerdict,
+    RollingReloadController,
+    RolloutConfig,
+)
 from apex_tpu.serving.weights import load_serving_params
 
 __all__ = [
@@ -249,4 +255,8 @@ __all__ = [
     "ShadowABScheduler",
     "WeightWatcher",
     "assign_arm",
+    "CanaryGate",
+    "CanaryVerdict",
+    "RollingReloadController",
+    "RolloutConfig",
 ]

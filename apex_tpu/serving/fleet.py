@@ -81,6 +81,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
+from apex_tpu.serving.reload import assign_arm
 from apex_tpu.serving.scheduler import (
     QueueFull,
     Request,
@@ -208,6 +209,10 @@ class FleetRouter:
         self._failovers_total = 0
         self._resumed_total = 0
         self._shed_total = 0
+        # canary traffic pin (rolling rollout): (name, fraction, seed)
+        # while active, plus the window's rid -> replica log
+        self._pin: Optional[tuple] = None
+        self._pin_log: Dict[str, str] = {}
 
     # ---- introspection (the LoadGenerator surface + fleet extras) --------
     @property
@@ -260,6 +265,14 @@ class FleetRouter:
     @property
     def steps_run(self) -> int:
         return self._steps
+
+    @property
+    def weights_steps(self) -> Dict[str, Optional[int]]:
+        """Per-replica checkpoint step being served (``None`` =
+        unknown provenance) — the mixed-version-fleet dashboard a
+        rolling upgrade is watched on."""
+        return {name: getattr(r.scheduler, "weights_step", None)
+                for name, r in self._replicas.items()}
 
     @property
     def fleet_stats(self) -> Dict[str, int]:
@@ -368,12 +381,54 @@ class FleetRouter:
             remaining.discard(pick)
         return order
 
+    def pin_traffic(self, name: str, *, fraction: float,
+                    seed: int = 0) -> None:
+        """Pin a seeded deterministic ``fraction`` of new placements to
+        replica ``name`` (the canary), reusing the shadow/A-B
+        :func:`~apex_tpu.serving.reload.assign_arm` rid hash: a rid
+        hashing under ``fraction`` places on the canary first, every
+        other rid avoids it — the split is exact and reproducible, not
+        statistical.  While pinned the router logs every placement
+        (rid → replica) so a :class:`~apex_tpu.serving.rollout.
+        CanaryGate` can split the window's request records into arms
+        after the fact; :meth:`unpin_traffic` returns the log.
+
+        The pin biases, it never strands: a full canary falls back to
+        the normal candidate order (losslessness outranks an exact
+        fraction), and a canary that leaves HEALTHY is simply skipped.
+        """
+        if name not in self._replicas:
+            raise KeyError(name)
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(
+                f"pin fraction must be in (0, 1], got {fraction}")
+        self._pin = (name, float(fraction), int(seed))
+        self._pin_log = {}
+
+    def unpin_traffic(self) -> Dict[str, str]:
+        """Clear the canary pin; returns the pinned window's placement
+        log (rid → replica) and forgets it."""
+        log, self._pin, self._pin_log = self._pin_log, None, {}
+        return log
+
     def submit(self, request: Request) -> None:
         """Place one request: affinity-first, WRR fallback, next-best
         retry on ``QueueFull``, fleet shed when every healthy replica
         refuses (the re-raised ``QueueFull`` is the open-loop
         loadgen's shed signal)."""
         order = self._candidate_order(request.prompt)
+        if self._pin is not None:
+            pin_name, fraction, seed = self._pin
+            if self._replicas[pin_name].state is ReplicaState.HEALTHY:
+                if assign_arm(request.rid, fraction=fraction, seed=seed):
+                    order = ([pin_name]
+                             + [n for n in order if n != pin_name])
+                else:
+                    rest = [n for n in order if n != pin_name]
+                    # never strand a request to honor the fraction: the
+                    # canary stays last-resort for the control arm
+                    order = rest + ([pin_name] if pin_name in order
+                                    else [])
         if not order:
             self._shed_total += 1
             emit_event("serving_fleet_shed", rid=request.rid,
@@ -388,9 +443,13 @@ class FleetRouter:
                 retries += 1
                 continue
             self._placed[request.rid] = name
+            if self._pin is not None:
+                self._pin_log[request.rid] = name
             self._routed_total += 1
             emit_event("serving_fleet_routed", rid=request.rid,
-                       replica=name, retries=retries)
+                       replica=name, retries=retries,
+                       weights_step=getattr(sched, "weights_step",
+                                            None))
             return
         self._shed_total += 1
         emit_event("serving_fleet_shed", rid=request.rid,
@@ -475,21 +534,32 @@ class FleetRouter:
         for p in self._pending:
             placed = False
             order = self._candidate_order(p.exp.request.prompt)
+
+            def _capture_ok(name: str) -> bool:
+                # captured bytes restore bit-exactly only into a dense
+                # engine serving the SAME weights version: a cross-
+                # version resume would splice two models into one
+                # stream (hybrid tokens no single-version run could
+                # ever produce)
+                sched = self._replicas[name].scheduler
+                return (sched.engine.paged is None
+                        and getattr(sched, "weights_step", None)
+                        == p.exp.weights_step)
+
             if p.exp.kv is not None and not any(
-                    self._replicas[n].scheduler.engine.paged is None
-                    for n in order):
-                # mixed fleet, no dense survivor: the captured bytes
-                # cannot restore into a paged engine — degrade to a
-                # bare requeue (deterministic replay re-earns the
-                # tokens; holding the capture would deadlock the drain)
+                    _capture_ok(n) for n in order):
+                # no same-version dense survivor (mixed fleet, or a
+                # rollout moved every peer to another weights step):
+                # degrade to a bare requeue — deterministic replay
+                # re-earns the tokens end-to-end on ONE version;
+                # holding the capture would deadlock the drain
                 p.exp.kv = None
                 p.exp.tokens = []
                 p.exp.t_first = 0.0
             for name in order:
                 sched = self._replicas[name].scheduler
-                if (p.exp.kv is not None
-                        and sched.engine.paged is not None):
-                    continue             # captured bytes need dense
+                if p.exp.kv is not None and not _capture_ok(name):
+                    continue
                 try:
                     ok = sched.adopt_stream(p.exp)
                 except QueueFull:
@@ -601,12 +671,22 @@ class FleetRouter:
 
     def replace(self, name: str, scheduler) -> None:
         """Swap in a rebuilt scheduler for a DEAD replica (same shared
-        clock required) and rejoin it fresh."""
+        clock required) and rejoin it fresh.  Refuses a replica that is
+        not DEAD: a live scheduler may hold in-flight streams, and
+        silently discarding it would drop them without a failover —
+        ``drain()`` + ``rejoin()`` is the live-replica path, ``kill()``
+        the destructive one."""
         if scheduler.clock is not self._clock:
             raise ValueError(
                 f"replace({name!r}): the new scheduler must share the "
                 f"fleet clock object")
         r = self._replicas[name]
+        if r.state is not ReplicaState.DEAD:
+            raise ValueError(
+                f"replace({name!r}): replica is {r.state.value}, not "
+                f"dead — replacing a live scheduler would drop its "
+                f"in-flight streams; drain() it first (or kill() it "
+                f"to force a failover)")
         r.scheduler = scheduler
         r.wedged = False
         r.stalled = False

@@ -247,6 +247,12 @@ class HotReloader:
         self._reloads = 0
         self._refusals = 0
         self._prefetches = 0
+        self._discarded_stages = 0
+        # seed the scheduler's served-version tag so routed/finished
+        # events carry the boot step from the first request on
+        if current_step is not None and hasattr(scheduler,
+                                                "weights_step"):
+            scheduler.weights_step = int(current_step)
 
     # ---- introspection ---------------------------------------------------
     @property
@@ -274,6 +280,7 @@ class HotReloader:
     def stats(self) -> Dict[str, int]:
         return {"reloads": self._reloads, "refusals": self._refusals,
                 "prefetches": self._prefetches,
+                "discarded_stages": self._discarded_stages,
                 "watcher_polls": self.watcher.polls}
 
     # ---- the lifecycle ---------------------------------------------------
@@ -375,6 +382,10 @@ class HotReloader:
             if want is not None and int(want) == self._staged[1]:
                 candidate, got, restore_s, validate_s = self._staged
                 prefetched = True
+            else:
+                self._discarded_stages += 1
+                logger.info("discarding stale stage (step %s): reload "
+                            "target is %s", self._staged[1], want)
             self._staged = None          # consumed or stale either way
 
         if candidate is None:
@@ -415,7 +426,8 @@ class HotReloader:
                                     validate_s)
 
         t2 = self._clock()
-        displaced = self.scheduler.swap_weights(candidate)
+        displaced = self.scheduler.swap_weights(candidate,
+                                                step=int(got))
         swap_s = self._clock() - t2
         self._previous = (displaced, self._current_step)
         from_step = self._current_step
@@ -441,9 +453,17 @@ class HotReloader:
         if self._previous is None:
             raise RuntimeError("rollback() with no retained previous "
                                "weights — no reload has succeeded yet")
+        if self._staged is not None:
+            # the stage belongs to the version line being abandoned: a
+            # later reload() consuming it would silently re-promote the
+            # rolled-back direction.  Discard it, counted.
+            self._discarded_stages += 1
+            logger.info("rollback discards staged step %s",
+                        self._staged[1])
+            self._staged = None
         params, prev_step = self._previous
         t0 = self._clock()
-        displaced = self.scheduler.swap_weights(params)
+        displaced = self.scheduler.swap_weights(params, step=prev_step)
         swap_s = self._clock() - t0
         from_step = self._current_step
         self._previous = (displaced, from_step)

@@ -252,6 +252,11 @@ class StreamExport:
     preemptions: int = 0
     length: int = 0                   # cached rows at capture
     kv: Optional[tuple] = None        # dense (k, v) host arrays
+    # checkpoint step the donor was serving at export (None = unknown).
+    # Captured bytes are only bit-faithful on a SAME-version adopter:
+    # the router degrades a cross-version capture to a bare requeue so
+    # no stream ever decodes a hybrid of two weight versions.
+    weights_step: Optional[int] = None
 
 
 @dataclasses.dataclass
@@ -392,6 +397,11 @@ class ContinuousBatchingScheduler:
         self._spec_emitted = 0
         self._spec_drafted = 0
         self._spec_accepted = 0
+        # checkpoint step of the weights being served (None = unknown
+        # provenance).  Set by swap_weights(step=) and seeded by
+        # HotReloader at construction; rides every routed/finished
+        # event so a mixed-version fleet mid-rollout is observable.
+        self.weights_step: Optional[int] = None
 
     # ---- submission ------------------------------------------------------
     def submit(self, request: Request) -> None:
@@ -985,7 +995,8 @@ class ContinuousBatchingScheduler:
         for slot, st in sorted(self._active.items(),
                                key=lambda kv_: kv_[1].seq):
             exp = StreamExport(request=st.request, t_submit=st.t_submit,
-                               preemptions=st.preemptions)
+                               preemptions=st.preemptions,
+                               weights_step=self.weights_step)
             if (capture and dense
                     and st.phase is RequestPhase.DECODE):
                 length = int(self.engine.lengths()[slot])
@@ -1005,7 +1016,8 @@ class ContinuousBatchingScheduler:
         for sus in self._suspended:
             st = sus.st
             exp = StreamExport(request=st.request, t_submit=st.t_submit,
-                               preemptions=st.preemptions)
+                               preemptions=st.preemptions,
+                               weights_step=self.weights_step)
             if capture and dense and sus.kv is not None:
                 exp.kv = sus.kv
                 exp.length = sus.length
@@ -1108,7 +1120,8 @@ class ContinuousBatchingScheduler:
                 # BlockPoolExhausted despite reclaimable blocks
                 self.engine.set_block_reclaim(None)
 
-    def swap_weights(self, params) -> object:
+    def swap_weights(self, params, *, step: Optional[int] = None
+                     ) -> object:
         """Hot-swap the engine's served weights at this step boundary;
         returns the displaced buffer (the caller's rollback copy).
 
@@ -1126,8 +1139,16 @@ class ContinuousBatchingScheduler:
         stop offering their (now hybrid) blocks.  The FIFO/default
         path with no swap ever requested is byte-for-byte untouched —
         this method is the ONLY reload surface the scheduler grows.
+
+        ``step`` records the candidate's checkpoint step in
+        :attr:`weights_step` (the :class:`~apex_tpu.serving.reload.
+        HotReloader` passes it on every reload and rollback); a raw
+        swap with no ``step`` honestly resets it to ``None`` — the
+        provenance is unknown, and a stale step on a routed/finished
+        event would lie about what served the request.
         """
         old = self.engine.swap_params(params)
+        self.weights_step = None if step is None else int(step)
         if self._prefix is not None:
             self._prefix.bump_version()
         return old
@@ -1358,7 +1379,8 @@ class ContinuousBatchingScheduler:
                    finish_reason=result.finish_reason,
                    new_tokens=len(result.tokens),
                    tokens_per_s=round(result.tokens_per_s, 3),
-                   per_token_ms=round(decode_s / decode_steps * 1e3, 3))
+                   per_token_ms=round(decode_s / decode_steps * 1e3, 3),
+                   weights_step=self.weights_step)
         return True
 
     def _spec_work(self, decoding: Dict[int, "_Active"]

@@ -1855,6 +1855,170 @@ def _serving_fleet_metrics(*, n_requests: int = 18, prompt_len: int = 32,
     }
 
 
+def _serving_rollout_metrics(*, n_requests: int = 36, prompt_len: int = 32,
+                             new_tokens: int = 6, prefill_len: int = 64,
+                             max_len: int = 128, slots: int = 2,
+                             n_replicas: int = 3, rate_rps: float = 10.0,
+                             step_time_s: float = 0.05,
+                             canary_fraction: float = 0.5,
+                             canary_window_steps: int = 16,
+                             health_window_steps: int = 2,
+                             seed: int = 19) -> dict:
+    """Rolling fleet upgrade (the BENCH_*.json ``serving_rollout``
+    block, ISSUE 18).
+
+    Protocol: a warmed ``n_replicas``-replica fleet serves a paced
+    open-loop workload on a shared virtual clock while a
+    :class:`~apex_tpu.serving.rollout.RollingReloadController`
+    upgrades every replica to a newer committed checkpoint — canary
+    first, traffic pinned, gate verdict, then the remaining waves.
+    Recorded: the real rollout wall (start → promoted, including the
+    serving work interleaved between phases — what an operator
+    actually waits), the per-replica swap pause (the reload's pointer
+    swap only; restore+validate ran off-path via prefetch),
+    ``dropped_streams`` (must be 0), and the canary-gate verdict
+    latency (window open → verdict, real wall).  Honesty caveats: all
+    replicas time-slice ONE host processor, so the rollout wall is
+    dominated by the serving work between phases, not by upgrade cost
+    — the transferable numbers are the swap pauses and dropped=0; and
+    the health/canary windows count *virtual* steps, so their real
+    wall scales with per-step compute, not with the configured
+    window.  The upgrade path must not compile anything new (the
+    candidate shares every shape/dtype with the boot params)."""
+    from apex_tpu import _logging
+    from apex_tpu import resilience as rz
+    from apex_tpu.obs import recording_requests
+    from apex_tpu.serving import (CanaryGate, ContinuousBatchingScheduler,
+                                  FleetRouter, HotReloader, LoadGenerator,
+                                  RolloutConfig, RollingReloadController,
+                                  VirtualClock, default_prefill_buckets,
+                                  make_workload, uniform_arrivals,
+                                  zero_overlap_prompts)
+    import shutil
+    import tempfile
+
+    cfg, model, params = _serving_bench_setup(max_len=max_len)
+    warm_lens = [prompt_len] + list(default_prefill_buckets(prefill_len))
+    engines = []
+    for _ in range(n_replicas):
+        eng, _ = _warm_serving_pair(
+            model, params, slots=slots, max_len=max_len,
+            prefill_len=prefill_len, warm_lens=warm_lens,
+            warm_prompt_len=min(prompt_len, max_len - 2))
+        engines.append(eng)
+    compiles_before = [(e.decode_compiles(), e.prefill_compiles())
+                       for e in engines]
+    prompts = zero_overlap_prompts(n_requests, length=prompt_len,
+                                   vocab=cfg.vocab_size, seed=seed)
+    wl = make_workload(prompts, uniform_arrivals(n_requests, rate_rps),
+                       max_new_tokens=new_tokens, rid_prefix="ro",
+                       seed=seed)
+
+    root = tempfile.mkdtemp(prefix="apex_rollout_bench_")
+    try:
+        rz.save_checkpoint(root, 200, {
+            "params": jax.tree.map(
+                lambda l: l + 0.01 if jnp.issubdtype(l.dtype,
+                                                     jnp.floating)
+                else l, params)})
+        vc = VirtualClock()
+        scheds = {f"r{i}": ContinuousBatchingScheduler(
+            e, max_queue=n_requests, log_interval=10 ** 9, clock=vc)
+            for i, e in enumerate(engines)}
+        router = FleetRouter(scheds)
+        reloaders = {name: HotReloader(s, root, like={"params": params},
+                                       params_key="params",
+                                       current_step=100)
+                     for name, s in scheds.items()}
+        events = []
+        _logging.add_event_sink(events.append)
+        try:
+            with recording_requests(clock=vc) as rec:
+                ctl = RollingReloadController(
+                    router, reloaders,
+                    config=RolloutConfig(
+                        step=200,
+                        canary_fraction=canary_fraction,
+                        canary_window_steps=canary_window_steps,
+                        health_window_steps=health_window_steps,
+                        gate=CanaryGate(completion_margin=0.3)),
+                    recorder=rec)
+                marks = {"canary0": None, "verdict": None, "end": None}
+
+                def hook(step, _sched):
+                    ctl.advance()
+                    now = time.perf_counter()
+                    if (marks["canary0"] is None
+                            and ctl.phase == "canary"):
+                        marks["canary0"] = now
+                    if (marks["verdict"] is None
+                            and ctl.verdict is not None):
+                        marks["verdict"] = now
+                    if marks["end"] is None and ctl.done:
+                        marks["end"] = now
+
+                ctl.start()
+                t0 = time.perf_counter()
+                out = LoadGenerator(router, wl, step_time_s=step_time_s,
+                                    step_hook=hook).run()
+                # the workload can drain before the last wave's health
+                # window closes — finish the rollout on an idle fleet
+                extra = 0
+                while not ctl.done and extra < 500:
+                    router.step()
+                    vc.advance(step_time_s)
+                    hook(extra, None)
+                    extra += 1
+        finally:
+            _logging.remove_event_sink(events.append)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    assert ctl.state == "promoted", \
+        f"bench rollout did not promote: {ctl.status}"
+    assert ctl.verdict is not None and ctl.verdict.passed, \
+        f"bench canary verdict failed: {ctl.verdict}"
+    dropped = out.offered - out.completed - len(out.rejected)
+    assert dropped == 0, f"rollout dropped {dropped} stream(s)"
+    steps_served = set(router.weights_steps.values())
+    assert steps_served == {200}, \
+        f"fleet did not converge on the candidate: {steps_served}"
+    for i, e in enumerate(engines):
+        assert (e.decode_compiles(), e.prefill_compiles()) == \
+            compiles_before[i], f"rollout recompiled on replica {i}"
+    halts = sum(1 for e in events
+                if e.get("event") == "serving_rollout_halted")
+    rollbacks = sum(int(e.get("replicas", 0)) for e in events
+                    if e.get("event") == "serving_rollout_rolled_back")
+    pauses = sorted(ctl.swap_pauses.values())
+    return {
+        "ok": True,
+        "replicas": n_replicas,
+        "rollout_wall_s": round(marks["end"] - t0, 4),
+        "swap_pause_s_max": round(pauses[-1], 5),
+        "swap_pause_s_mean": round(sum(pauses) / len(pauses), 5),
+        "verdict_latency_s": round(marks["verdict"] - marks["canary0"],
+                                   4),
+        "dropped_streams": dropped,
+        "halts": halts,
+        "rollbacks": rollbacks,
+        "completed": out.completed,
+        "shed": len(out.rejected),
+        "canary_offered": ctl.verdict.canary["offered"],
+        "canary_completed": ctl.verdict.canary["completed"],
+        "decode_compiles": sum(e.decode_compiles() for e in engines),
+        "prefill_compiles": sum(e.prefill_compiles() for e in engines),
+        "config": {"n_requests": n_requests, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "slots": slots,
+                   "max_len": max_len, "prefill_len": prefill_len,
+                   "rate_rps": rate_rps, "step_time_s": step_time_s,
+                   "canary_fraction": canary_fraction,
+                   "canary_window_steps": canary_window_steps,
+                   "health_window_steps": health_window_steps,
+                   "seed": seed},
+    }
+
+
 def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
     """Observability tax of the ISSUE-6 layer (the BENCH_*.json ``obs``
     block): per-update cost of each instrument kind, span enter/exit
@@ -2121,6 +2285,11 @@ def run_config(name: str, *, batch: int | None = None,
         serving_fleet = {"ok": False,
                          "error": f"{type(e).__name__}: {e}"[:200]}
     try:
+        serving_rollout = _serving_rollout_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        serving_rollout = {"ok": False,
+                           "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
         obs = _obs_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
@@ -2146,6 +2315,7 @@ def run_config(name: str, *, batch: int | None = None,
         "serving_slo": serving_slo,
         "serving_reload": serving_reload,
         "serving_fleet": serving_fleet,
+        "serving_rollout": serving_rollout,
         "obs": obs,
         "config": out_cfg,
     }

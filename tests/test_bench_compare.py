@@ -50,6 +50,21 @@ GOLDEN_OLD = {
         "decode_compiles": 3,
         "config": {"kill_step": 4},
     },
+    "serving_rollout": {
+        "ok": True,
+        "replicas": 3,
+        "rollout_wall_s": 1.5,
+        "swap_pause_s_max": 0.001,
+        "swap_pause_s_mean": 0.0008,
+        "verdict_latency_s": 0.2,
+        "dropped_streams": 0,
+        "halts": 0,
+        "rollbacks": 0,
+        "shed": 0,
+        "canary_completed": 2,
+        "decode_compiles": 3,
+        "config": {"canary_window_steps": 16},
+    },
 }
 
 
@@ -130,6 +145,28 @@ class TestClassify:
         assert bc.classify(f"{base}.decode_compiles") == "exact"
         assert bc.classify(f"{base}.config.kill_step") is None
         assert bc.classify(f"{base}.resumed") is None
+
+    def test_rollout_family_direction_aware(self):
+        """The ISSUE-18 serving_rollout block: the wall, the swap
+        pause, the verdict latency and dropped streams grade lower;
+        halt/abort/rollback counts are GRADED outcomes inside this
+        family (zero-baseline: any new one is a regression) but not
+        elsewhere; the canary arm counts are workload shape."""
+        base = "serving_rollout"
+        assert bc.classify(f"{base}.ok") == "exact_higher"
+        assert bc.classify(f"{base}.rollout_wall_s") == "lower"
+        assert bc.classify(f"{base}.swap_pause_s_max") == "lower"
+        assert bc.classify(f"{base}.verdict_latency_s") == "lower"
+        assert bc.classify(f"{base}.dropped_streams") == "lower"
+        for graded in ("halts", "aborts", "rollbacks", "pause"):
+            assert bc.classify(f"{base}.{graded}") == "lower", graded
+            assert bc.classify(f"serving_fleet.{graded}") is None, graded
+        assert bc.classify("serving_slo.halts") is None
+        assert bc.classify(f"{base}.decode_compiles") == "exact"
+        assert bc.classify(f"{base}.canary_completed") is None
+        assert bc.classify(f"{base}.replicas") is None
+        assert bc.classify(f"{base}.shed") is None
+        assert bc.classify(f"{base}.config.canary_window_steps") is None
 
     def test_shed_graded_only_inside_fleet_family(self):
         """``shed`` is a workload-shape activity count everywhere else
@@ -239,6 +276,27 @@ class TestCompare:
         kinds = _kinds(bc.compare(GOLDEN_OLD, better))
         assert kinds["serving_fleet.failover_latency_s"] == "improvement"
         assert kinds["serving_fleet.goodput_delta"] == "improvement"
+
+    def test_rollout_regressions_flagged(self):
+        worse = _mutated(**{"serving_rollout.halts": 1,
+                            "serving_rollout.rollbacks": 3,
+                            "serving_rollout.dropped_streams": 1,
+                            "serving_rollout.swap_pause_s_max": 0.01,
+                            "serving_rollout.rollout_wall_s": 3.0})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        # zero-baseline: ANY new halt / rollback / dropped stream is
+        # outside tolerance
+        assert kinds["serving_rollout.halts"] == "regression"
+        assert kinds["serving_rollout.rollbacks"] == "regression"
+        assert kinds["serving_rollout.dropped_streams"] == "regression"
+        assert kinds["serving_rollout.swap_pause_s_max"] == "regression"
+        assert kinds["serving_rollout.rollout_wall_s"] == "regression"
+        flip = _mutated(**{"serving_rollout.ok": False})
+        assert _kinds(bc.compare(GOLDEN_OLD, flip))[
+            "serving_rollout.ok"] == "regression"
+        faster = _mutated(**{"serving_rollout.verdict_latency_s": 0.1})
+        assert _kinds(bc.compare(GOLDEN_OLD, faster))[
+            "serving_rollout.verdict_latency_s"] == "improvement"
 
     def test_missing_graded_metric_flagged(self):
         new = json.loads(json.dumps(GOLDEN_OLD))

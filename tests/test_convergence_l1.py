@@ -47,6 +47,8 @@ def test_policy_trace_matches_o0(o0_trace, opt_level, loss_scale, half):
                                rtol=0.2, atol=0.35)
 
 
+@pytest.mark.slow   # ~16 s: tier-1 keeps the checkpoint round-trip
+# witnesses in test_resilience.py and the remaining convergence cells
 def test_checkpoint_save_resume_trace_continues(tmp_path):
     ckpt = str(tmp_path / "ckpt")
     full = run_training(opt_level="O2", **TINY)["losses"]

@@ -430,6 +430,132 @@ class TestFleetHealth:
 
 
 # ---------------------------------------------------------------------------
+# lifecycle edges (ISSUE 18 satellites): drain/watchdog interplay and
+# the refusal paths a rolling upgrade leans on
+# ---------------------------------------------------------------------------
+
+
+class TestFleetLifecycleEdges:
+    def test_draining_replica_survives_slow_reload(self, fleet_engines,
+                                                   isolated_tokens):
+        """The drain/watchdog audit: a DRAINING replica whose reload
+        runs long (many missed beats, far past dead_after_s) is NEVER
+        escalated SUSPECT→DEAD by its own drain — drain already
+        evacuated it, and a watchdog kill would close the scheduler a
+        reload is about to hand back."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(
+            fleet_engines, clk,
+            config=sv.FleetConfig(suspect_after_s=0.5, dead_after_s=1.0))
+        req = sv.Request("sl", _prompt(200), max_new_tokens=6)
+        router.submit(req)
+        for _ in range(2):
+            router.step()
+            clk.advance(0.25)
+        target = router.placement_of("sl")
+        router.drain(target)
+        with _EventTap() as tap:
+            # a slow reload: the drained replica misses every beat for
+            # 2.0s of clock — double dead_after_s
+            for _ in range(8):
+                router.stall(target)
+                router.step()
+                clk.advance(0.25)
+        assert router.state_of(target) is sv.ReplicaState.DRAINING
+        # no watchdog transition fired for it, and nothing was
+        # evacuated a second time
+        assert [e for e in tap.of("serving_fleet_replica_state")
+                if e["replica"] == target] == []
+        assert tap.of("serving_fleet_failover") == []
+        router.rejoin(target)
+        assert router.state_of(target) is sv.ReplicaState.HEALTHY
+        # the rejoined replica serves again and the drained stream
+        # finished unharmed elsewhere
+        results = router.run()
+        assert results["sl"].tokens == isolated_tokens(req)
+        router.submit(sv.Request("post", _prompt(201), max_new_tokens=1))
+        assert router.run()["post"].finish_reason in sv.SERVED_REASONS
+
+    def test_rejoin_of_never_drained_replica_is_benign(
+            self, fleet_engines, isolated_tokens):
+        """rejoin() of a HEALTHY replica that was never drained: no
+        state transition, no stream disturbed — just a beat+credit
+        reset (the idempotent half of the rolling-reload pair)."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        req = sv.Request("rj", _prompt(210), max_new_tokens=6)
+        router.submit(req)
+        home = router.placement_of("rj")
+        for _ in range(2):
+            router.step()
+            clk.advance(0.25)
+        with _EventTap() as tap:
+            router.rejoin(home)
+        assert router.state_of(home) is sv.ReplicaState.HEALTHY
+        assert tap.of("serving_fleet_replica_state") == []
+        assert router.replica(home).active_count == 1   # untouched
+        assert router.run()["rj"].tokens == isolated_tokens(req)
+
+    def test_replace_of_live_replica_refused(self, fleet_engines,
+                                             isolated_tokens):
+        """replace() of a live replica is refused — silently swapping
+        a live scheduler would drop its in-flight streams without a
+        failover.  The fleet is untouched by the refusal."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        req = sv.Request("rp", _prompt(220), max_new_tokens=6)
+        router.submit(req)
+        home = router.placement_of("rp")
+        for _ in range(2):
+            router.step()
+            clk.advance(0.25)
+        original = router.replica(home)
+        fresh = sv.ContinuousBatchingScheduler(
+            original.engine, max_queue=8, log_interval=10 ** 9,
+            clock=clk)
+        with pytest.raises(ValueError, match="drain"):
+            router.replace(home, fresh)
+        # untouched: same scheduler object, same state, stream lives
+        assert router.replica(home) is original
+        assert router.state_of(home) is sv.ReplicaState.HEALTHY
+        assert router.replica(home).active_count == 1
+        assert router.run()["rp"].tokens == isolated_tokens(req)
+
+    def test_drain_of_last_healthy_replica_refused_fleet_untouched(
+            self, fleet_engines, isolated_tokens):
+        """drain() of the last healthy replica must refuse (there is
+        nowhere to move its streams) and leave the fleet untouched:
+        the replica stays HEALTHY, its streams stay put, nothing is
+        exported."""
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        req = sv.Request("lh", _prompt(230), max_new_tokens=6)
+        router.submit(req)
+        last = router.placement_of("lh")
+        for _ in range(2):
+            router.step()
+            clk.advance(0.25)
+        for name in router.replica_names:
+            if name != last:
+                router.drain(name)
+        with _EventTap() as tap:
+            with pytest.raises(ValueError, match="no other healthy"):
+                router.drain(last)
+        # untouched: still HEALTHY, stream still home, no export fired
+        assert router.state_of(last) is sv.ReplicaState.HEALTHY
+        assert router.placement_of("lh") == last
+        assert router.replica(last).active_count == 1
+        assert tap.of("serving_fleet_failover") == []
+        assert [e for e in tap.of("serving_fleet_replica_state")
+                if e["replica"] == last] == []
+        assert router.run()["lh"].tokens == isolated_tokens(req)
+        for name in router.replica_names:
+            if name != last:
+                router.rejoin(name)
+        assert router.replicas_healthy == 3
+
+
+# ---------------------------------------------------------------------------
 # paged fleet teardown: a killed replica never leaks pins or blocks
 # ---------------------------------------------------------------------------
 
@@ -564,6 +690,8 @@ class TestFleetChaosAcceptance:
         assert g_failover >= g_none + 0.1, \
             f"failover goodput {g_failover} vs no-failover {g_none}"
 
+    @pytest.mark.slow   # ~5 s: tier-1 keeps the dense chaos acceptance
+    # run above (the gate) — this is its tp=2 composition variant
     def test_kill_mid_stream_tp2_token_identical(self, model, params,
                                                  isolated_tokens):
         """The tp=2 variant: a 2-replica tp fleet loses one replica

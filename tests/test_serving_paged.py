@@ -297,6 +297,8 @@ def test_engine_prefill_decode_bit_identical(model, params, block_size):
     assert paged.prefill_compiles() <= len(paged.prefill_buckets)
 
 
+@pytest.mark.slow   # ~7 s: tier-1 keeps the dense spec-verify parity
+# witnesses in test_serving.py and the sharded one in test_serving_tp.py
 def test_engine_verify_draft_bit_identical(model, params):
     dense, paged = _engines(model, params)
     prompt = _prompt(seed=2, n=30)
@@ -320,6 +322,9 @@ def test_engine_verify_draft_bit_identical(model, params):
     assert np.array_equal(np.asarray(ld), np.asarray(lp))
 
 
+@pytest.mark.slow   # ~12 s: tier-1 keeps the engine-level paged==dense
+# bit-identity witnesses (test_engine_prefill_decode_bit_identical[12/16])
+# plus the paged scheduler streams driven by the policy/fleet/rollout suites
 def test_scheduler_streams_bit_identical_multi_stream(model, params):
     """THE scheduler acceptance run: 4 shared-prefix prompts through
     dense, paged, paged+speculation, and paged+prefix-caching

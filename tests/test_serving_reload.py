@@ -496,6 +496,33 @@ class TestHotReloader:
         assert rb2.ok and rb2.step == 200
         assert eng.weights_version == 3
 
+    def test_rollback_discards_staged_prefetch(self, model, params,
+                                               tmp_path):
+        """ISSUE 18 satellite: a stage prefetched from the version
+        line being abandoned dies with the rollback — a later reload()
+        must NOT silently re-promote the rolled-back direction — and
+        the discard is counted in ``stats['discarded_stages']``."""
+        _save_versions(tmp_path, params, 100, 200, 300)
+        boot, _ = sv.load_serving_params(
+            str(tmp_path), {"params": params}, params_key="params",
+            step=100)
+        original = jax.tree.map(lambda l: np.asarray(l).copy(), boot)
+        eng = _engine(model, boot, slots=2)
+        rl = sv.HotReloader(_sched(eng), str(tmp_path),
+                            like={"params": params},
+                            params_key="params", current_step=100)
+        assert rl.reload(step=200).ok
+        assert rl.prefetch(step=300) == 300      # restore-ahead staged
+        assert rl.staged_step == 300
+        assert rl.stats["discarded_stages"] == 0
+        rb = rl.rollback()
+        assert rb.ok and rb.rollback and rb.step == 100
+        # the stage belonged to the abandoned line: discarded, counted
+        assert rl.staged_step is None
+        assert rl.stats["discarded_stages"] == 1
+        assert rl.current_step == 100
+        assert _tree_bytes_equal(eng.params, original)
+
     def test_retry_policy_wraps_transient_io_only(self, model, params,
                                                   tmp_path):
         """Deterministic corruption propagates through retry_transient
@@ -680,6 +707,9 @@ class TestAcceptanceRun:
         assert {k: v.tokens for k, v in swapped.results.items()} != \
                {k: v.tokens for k, v in plain.results.items()}
 
+    @pytest.mark.slow   # ~5 s: tier-1 keeps the dense+paged mid-stream
+    # swap zero-drop witnesses above plus the weights-onto-mesh restore
+    # witnesses in test_serving_tp.py
     @pytest.mark.skipif(not devices_available(2),
                         reason=device_count_skip_reason(2))
     def test_tp2_swap_stream_identical_to_single_chip_swap(
@@ -927,6 +957,8 @@ class TestShadowAB:
             primary, shadow,
             sv.ABConfig(fraction=fraction, seed=seed))
 
+    @pytest.mark.slow   # ~4 s: tier-1 keeps the seed-deterministic
+    # mirror + reconciling arm-reports witness of the A/B claim
     def test_identical_weights_arms_emit_identical_streams(self, model,
                                                            params):
         """The null experiment: candidate == incumbent weights ⇒ every

@@ -1,0 +1,735 @@
+"""Rolling fleet upgrades: health-gated rolling reload, canary
+replica, automatic fleet rollback (ISSUE 18).
+
+THE acceptance run: a 3-replica fleet under a ~2x open-loop overload
+rolls every replica to a newer committed checkpoint — canary first
+with traffic pinned and a gate verdict, then the remaining waves —
+with **zero dropped streams**, every served stream token-identical to
+its unperturbed single-version reference, all replicas converged on
+the new ``weights_step``, and exactly one decode compile per engine.
+
+The chaos variants: a candidate that validates clean but serves
+measurably worse fails the canary gate → automatic halt + fleet
+rollback leaves every replica **bit-exact** on the old weights, and
+the gated rollout's goodput strictly beats the same rollout with the
+gate disabled; a candidate corrupted mid-rollout is refused
+first-class and rolled back; a canary killed mid-verdict-window
+aborts the rollout and its streams replay losslessly on the
+old-version survivors.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import _logging, obs
+from apex_tpu import resilience as rz
+from apex_tpu import serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+from apex_tpu.obs.slo import SLOReport
+from apex_tpu.resilience.fault_injection import (
+    CorruptCandidateMidRollout,
+    KillCanary,
+    RegressingWeights,
+)
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256)
+MAX = 96
+STEP_S = 0.25
+BOOT_STEP = 100
+TARGET = 200
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def _fleet_mod(model, params):
+    """Three independent 2-slot dense engines — the fleet.  Module
+    -scoped (each jit family compiles once per engine)."""
+    return tuple(sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                                 prefill_len=32) for _ in range(3))
+
+
+@pytest.fixture
+def fleet_engines(_fleet_mod, params):
+    """Reset before each test AND restore the boot weights after — a
+    rollout test leaves candidate params swapped in."""
+    for e in _fleet_mod:
+        e.swap_params(params)
+        e.reset()
+    yield _fleet_mod
+    for e in _fleet_mod:
+        e.swap_params(params)
+        e.reset()
+
+
+@pytest.fixture(scope="module")
+def _ref_mod(model, params):
+    return sv.DecodeEngine(model, params, slots=1, max_len=MAX,
+                           prefill_len=32)
+
+
+@pytest.fixture(scope="module")
+def isolated_tokens(_ref_mod):
+    """``fn(request) -> tokens``: the request's stream run alone on a
+    FIFO scheduler — the unperturbed single-version reference."""
+    eng = _ref_mod
+    memo = {}
+
+    def run(request):
+        key = (tuple(request.prompt), request.max_new_tokens,
+               request.eos_id, request.temperature, request.top_k,
+               request.seed)
+        if key not in memo:
+            eng.reset()
+            sched = sv.ContinuousBatchingScheduler(eng, max_queue=4)
+            sched.submit(sv.Request("ref", request.prompt,
+                                    max_new_tokens=request.max_new_tokens,
+                                    eos_id=request.eos_id,
+                                    temperature=request.temperature,
+                                    top_k=request.top_k,
+                                    seed=request.seed))
+            memo[key] = sched.run()["ref"].tokens
+        return memo[key]
+
+    return run
+
+
+def _prompt(seed, n=8):
+    return [int(x)
+            for x in np.random.default_rng(seed).integers(0, 128, n)]
+
+
+def _mutated(tree, delta):
+    return jax.tree.map(
+        lambda l: l + delta if jnp.issubdtype(l.dtype, jnp.floating)
+        else l, tree)
+
+
+def _tree_bytes_equal(a, b):
+    fa, fb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(fa) == len(fb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(fa, fb))
+
+
+def _mk_fleet(engines, clk, *, max_queue=16, config=None):
+    scheds = {
+        f"r{i}": sv.ContinuousBatchingScheduler(
+            e, max_queue=max_queue, log_interval=10 ** 9, clock=clk)
+        for i, e in enumerate(engines)}
+    return sv.FleetRouter(scheds,
+                          config=config if config is not None
+                          else sv.FleetConfig())
+
+
+def _mk_reloaders(router, root, params, *, current_step=BOOT_STEP):
+    return {name: sv.HotReloader(router.replica(name), str(root),
+                                 like={"params": params},
+                                 params_key="params",
+                                 current_step=current_step)
+            for name in router.replica_names}
+
+
+def _workload(n=16, *, max_new=5, deadline_s=60.0, seed_base=300,
+              rate=8.0):
+    """~2x overload: n requests arrive inside n/rate seconds of virtual
+    time while the 3x2-slot fleet needs several times that."""
+    prompts = [_prompt(seed_base + i) for i in range(n)]
+    return sv.make_workload(prompts, sv.uniform_arrivals(n, rate),
+                            max_new_tokens=max_new,
+                            deadline_s=deadline_s, rid_prefix="ro")
+
+
+def _chain(*hooks):
+    def hook(step, router):
+        for h in hooks:
+            h(step, router)
+    return hook
+
+
+def _drive_to_terminal(router, clk, ctl, *extra_hooks, limit=300):
+    """The workload can drain before the rollout's last wave — keep
+    stepping the idle fleet until the controller lands terminal."""
+    steps = 0
+    while not ctl.done and steps < limit:
+        router.step()
+        clk.advance(STEP_S)
+        ctl.advance()
+        for h in extra_hooks:
+            h(10_000 + steps, router)
+        steps += 1
+    assert ctl.done, f"rollout never terminal: {ctl.status}"
+
+
+def _assert_zero_dropped(out, wl):
+    """Zero admitted streams dropped: everything offered either shed
+    at submit (counted) or finished with full service."""
+    admitted = [r for r in wl.requests if r.rid not in set(out.rejected)]
+    for req in admitted:
+        res = out.results.get(req.rid)
+        assert res is not None and res.finish_reason \
+            in sv.SERVED_REASONS, \
+            f"{req.rid} dropped: {res and res.finish_reason}"
+
+
+class _EventTap:
+    def __init__(self):
+        self.events = []
+
+    def __enter__(self):
+        self._sink = lambda e: self.events.append(dict(e))
+        _logging.add_event_sink(self._sink)
+        return self
+
+    def __exit__(self, *exc):
+        _logging.remove_event_sink(self._sink)
+
+    def of(self, kind):
+        return [e for e in self.events if e.get("event") == kind]
+
+
+# ---------------------------------------------------------------------------
+# gate units
+# ---------------------------------------------------------------------------
+
+
+def _slo(completed, offered, *, tpot_p95=0.25, ttft_p95=0.5,
+         goodput=None):
+    return SLOReport(offered=offered, completed=completed,
+                     incomplete=offered - completed, duration_s=1.0,
+                     throughput_rps=None,
+                     output_tokens=completed * 5, tokens_per_s=None,
+                     ttft={"p95": ttft_p95}, tpot={"p95": tpot_p95},
+                     queue_wait={}, total={}, goodput=goodput,
+                     deadline_misses=0)
+
+
+class TestCanaryGate:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="ratios"):
+            sv.CanaryGate(tpot_ratio=0.0)
+        with pytest.raises(ValueError, match="min_samples"):
+            sv.CanaryGate(min_samples=0)
+
+    def test_identical_arms_pass(self):
+        ok, reasons = sv.CanaryGate().verdict(_slo(5, 6), _slo(5, 6))
+        assert ok and reasons == []
+
+    def test_fails_closed_on_empty_canary(self):
+        """A canary that served nothing in the window FAILS — silence
+        is itself a regression signal."""
+        ok, reasons = sv.CanaryGate().verdict(_slo(0, 4), _slo(6, 6))
+        assert not ok
+        assert any("fail-closed" in r for r in reasons)
+
+    def test_tpot_regression_fails(self):
+        ok, reasons = sv.CanaryGate(tpot_ratio=1.5).verdict(
+            _slo(5, 6, tpot_p95=0.50), _slo(5, 6, tpot_p95=0.25))
+        assert not ok
+        assert any("tpot" in r for r in reasons)
+
+    def test_ttft_regression_fails(self):
+        ok, reasons = sv.CanaryGate(ttft_ratio=1.5).verdict(
+            _slo(5, 6, ttft_p95=2.0), _slo(5, 6, ttft_p95=0.5))
+        assert not ok
+        assert any("ttft" in r for r in reasons)
+
+    def test_completion_rate_regression_fails(self):
+        ok, reasons = sv.CanaryGate(completion_margin=0.1).verdict(
+            _slo(5, 10), _slo(10, 10))
+        assert not ok
+        assert any("completion" in r for r in reasons)
+
+    def test_goodput_regression_fails(self):
+        ok, reasons = sv.CanaryGate(goodput_margin=0.05).verdict(
+            _slo(5, 5, goodput=0.5), _slo(5, 5, goodput=0.9))
+        assert not ok
+        assert any("goodput" in r for r in reasons)
+
+    def test_thin_baseline_skips_comparisons(self):
+        """No baseline samples → only the fail-closed check applies
+        (the guard keeps the gate honest on thin windows)."""
+        ok, reasons = sv.CanaryGate().verdict(
+            _slo(3, 3, tpot_p95=9.9), _slo(0, 0))
+        assert ok and reasons == []
+
+    def test_non_finite_series_skipped(self):
+        ok, _ = sv.CanaryGate().verdict(
+            _slo(5, 6, tpot_p95=float("nan")), _slo(5, 6))
+        assert ok
+
+    def test_rollout_config_validation(self):
+        with pytest.raises(ValueError, match="batch_size"):
+            sv.RolloutConfig(batch_size=0)
+        with pytest.raises(ValueError, match="health_window_steps"):
+            sv.RolloutConfig(health_window_steps=-1)
+        with pytest.raises(ValueError, match="canary_fraction"):
+            sv.RolloutConfig(canary_fraction=0.0)
+        with pytest.raises(ValueError, match="canary_fraction"):
+            sv.RolloutConfig(canary_fraction=1.5)
+        with pytest.raises(ValueError, match="canary_window_steps"):
+            sv.RolloutConfig(canary_window_steps=0)
+
+
+# ---------------------------------------------------------------------------
+# controller + pin units
+# ---------------------------------------------------------------------------
+
+
+class TestControllerUnits:
+    def test_reloaders_must_cover_fleet(self, fleet_engines, params,
+                                        tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        del reloaders["r2"]
+        with pytest.raises(ValueError, match="cover the fleet"):
+            sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(gate=None))
+
+    def test_reloader_must_wrap_router_scheduler(self, fleet_engines,
+                                                 params, tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        reloaders["r0"] = sv.HotReloader(
+            router.replica("r1"), str(tmp_path),
+            like={"params": params}, params_key="params",
+            current_step=BOOT_STEP)
+        with pytest.raises(ValueError, match="different scheduler"):
+            sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(gate=None))
+
+    def test_gated_requires_recorder(self, fleet_engines, params,
+                                     tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        with pytest.raises(ValueError, match="recorder"):
+            sv.RollingReloadController(
+                router, _mk_reloaders(router, tmp_path, params))
+
+    def test_start_twice_refused(self, fleet_engines, params, tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        rz.save_checkpoint(str(tmp_path), TARGET, {"params": params})
+        ctl = sv.RollingReloadController(
+            router, _mk_reloaders(router, tmp_path, params),
+            config=sv.RolloutConfig(gate=None))
+        assert ctl.start(step=TARGET) == TARGET
+        with pytest.raises(RuntimeError, match="one controller"):
+            ctl.start(step=TARGET)
+
+    def test_start_without_target_refused(self, fleet_engines, params,
+                                          tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        ctl = sv.RollingReloadController(
+            router, _mk_reloaders(router, tmp_path, params),
+            config=sv.RolloutConfig(gate=None))
+        with pytest.raises(ValueError, match="no target step"):
+            ctl.start()                 # empty root: nothing committed
+
+    def test_single_replica_fleet_refused(self, fleet_engines, params,
+                                          tmp_path):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines[:1], clk)
+        ctl = sv.RollingReloadController(
+            router, _mk_reloaders(router, tmp_path, params),
+            config=sv.RolloutConfig(gate=None))
+        with pytest.raises(ValueError, match="2 replicas"):
+            ctl.start(step=TARGET)
+
+    def test_pin_traffic_validation(self, fleet_engines):
+        router = _mk_fleet(fleet_engines, sv.VirtualClock())
+        with pytest.raises(KeyError):
+            router.pin_traffic("nope", fraction=0.5)
+        with pytest.raises(ValueError, match="fraction"):
+            router.pin_traffic("r0", fraction=0.0)
+        with pytest.raises(ValueError, match="fraction"):
+            router.pin_traffic("r0", fraction=1.5)
+
+    def test_pin_traffic_exact_seeded_split_and_log(self,
+                                                    fleet_engines):
+        """The pin is an exact deterministic split (assign_arm rid
+        hash), not statistical — and the pinned window's placement log
+        comes back from unpin_traffic()."""
+        router = _mk_fleet(fleet_engines, sv.VirtualClock())
+        router.pin_traffic("r0", fraction=0.5, seed=7)
+        rids = [f"p{i}" for i in range(10)]
+        for i, rid in enumerate(rids):
+            router.submit(sv.Request(rid, _prompt(500 + i),
+                                     max_new_tokens=1))
+        arms = {rid: sv.assign_arm(rid, fraction=0.5, seed=7)
+                for rid in rids}
+        assert any(arms.values()) and not all(arms.values())
+        for rid in rids:
+            placed = router.placement_of(rid)
+            assert (placed == "r0") == arms[rid], \
+                f"{rid}: arm={arms[rid]} placed={placed}"
+        log = router.unpin_traffic()
+        assert log == {rid: ("r0" if arms[rid] else
+                             router.placement_of(rid))
+                       for rid in rids}
+        assert router.unpin_traffic() == {}      # log is forgotten
+        router.run()
+
+    def test_pin_never_strands_and_skips_unhealthy_canary(
+            self, fleet_engines):
+        """Losslessness outranks the fraction: a drained canary is
+        skipped, the pinned-arm request places on a survivor."""
+        router = _mk_fleet(fleet_engines, sv.VirtualClock())
+        router.pin_traffic("r0", fraction=1.0, seed=0)
+        router.drain("r0")
+        router.submit(sv.Request("x", _prompt(510), max_new_tokens=1))
+        assert router.placement_of("x") != "r0"
+        assert router.unpin_traffic() == {"x": router.placement_of("x")}
+        router.rejoin("r0")
+        router.run()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: rolling upgrade under overload
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutAcceptance:
+    def test_health_gated_rolling_upgrade_zero_drop_token_identical(
+            self, fleet_engines, params, tmp_path, isolated_tokens):
+        """3 replicas, ~2x open-loop load, gated rolling upgrade to a
+        newer committed checkpoint: zero dropped streams, every stream
+        token-identical to its unperturbed single-version reference,
+        all replicas converge on the new weights_step, one decode
+        compile per engine, and the full event ledger in order."""
+        obs.metrics.reset()
+        # the candidate carries the SAME weights at a newer step:
+        # token identity to ONE reference is then exact by
+        # construction whichever version served each token
+        rz.save_checkpoint(str(tmp_path), TARGET, {"params": params})
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        wl = _workload()
+        with _EventTap() as tap, obs.recording_requests(clock=clk) as rec:
+            ctl = sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(
+                    step=TARGET, canary_fraction=0.34,
+                    canary_window_steps=10, health_window_steps=1,
+                    gate=sv.CanaryGate(ttft_ratio=3.0,
+                                       completion_margin=0.5)),
+                recorder=rec)
+            assert ctl.start() == TARGET      # newest committed
+            out = sv.LoadGenerator(router, wl, step_time_s=STEP_S,
+                                   step_hook=ctl).run()
+            _drive_to_terminal(router, clk, ctl)
+
+        assert ctl.state == "promoted", ctl.status
+        assert ctl.canary == "r0"
+        assert ctl.upgraded == ["r0", "r1", "r2"]
+        assert ctl.verdict is not None and ctl.verdict.passed
+        assert ctl.verdict.canary["completed"] >= 1
+
+        # zero dropped, every stream bit-identical to its reference
+        assert out.rejected == []
+        _assert_zero_dropped(out, wl)
+        for req in wl.requests:
+            assert out.results[req.rid].tokens == isolated_tokens(req), \
+                f"{req.rid} diverged across the rolling upgrade"
+
+        # the fleet converged on the candidate; swap pauses recorded
+        assert router.weights_steps == {"r0": TARGET, "r1": TARGET,
+                                        "r2": TARGET}
+        assert set(ctl.swap_pauses) == {"r0", "r1", "r2"}
+        assert all(p >= 0.0 for p in ctl.swap_pauses.values())
+        for e in fleet_engines:
+            assert e.decode_compiles() == 1
+
+        # event ledger: started -> 3 upgrades (canary first, all
+        # prefetched) -> pass verdict -> promoted; nothing halted
+        assert len(tap.of("serving_rollout_started")) == 1
+        ups = tap.of("serving_rollout_replica_upgraded")
+        assert [(e["replica"], e["canary"]) for e in ups] \
+            == [("r0", True), ("r1", False), ("r2", False)]
+        assert all(e["prefetched"] and e["from_step"] == BOOT_STEP
+                   and e["step"] == TARGET for e in ups)
+        verdicts = tap.of("serving_rollout_canary_verdict")
+        assert [e["verdict"] for e in verdicts] == ["pass"]
+        assert len(tap.of("serving_rollout_promoted")) == 1
+        assert tap.of("serving_rollout_halted") == []
+        assert tap.of("serving_rollout_rolled_back") == []
+
+        # the obs bridge surfaced the rollout lifecycle
+        snap = obs.snapshot()
+        promoted = snap["apex_serving_rollout_promotions_total"]["series"]
+        assert promoted and promoted[0]["value"] == 1
+        active = snap["apex_serving_rollout_active"]["series"]
+        assert active and active[0]["value"] == 0
+        upgraded = snap[
+            "apex_serving_rollout_replicas_upgraded_total"]["series"]
+        assert upgraded and upgraded[0]["value"] == 3
+
+    def test_mixed_version_window_has_no_hybrid_streams(
+            self, fleet_engines, params, tmp_path, _ref_mod,
+            isolated_tokens):
+        """An ungated rolling reload to genuinely different weights:
+        mid-rollout the fleet serves two versions, and every finished
+        stream matches EITHER the old-version or the new-version
+        isolated reference — never a hybrid of the two (cross-version
+        captures degrade to a full deterministic replay)."""
+        params_v2 = _mutated(params, 0.05)
+        rz.save_checkpoint(str(tmp_path), TARGET, {"params": params_v2})
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        wl = _workload(seed_base=340)
+        with _EventTap() as tap:
+            ctl = sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(step=TARGET,
+                                        health_window_steps=1,
+                                        gate=None))
+            ctl.start()
+            out = sv.LoadGenerator(router, wl, step_time_s=STEP_S,
+                                   step_hook=ctl).run()
+            _drive_to_terminal(router, clk, ctl)
+
+        assert ctl.state == "promoted", ctl.status
+        assert ctl.canary is None               # ungated: no pin phase
+        assert tap.of("serving_rollout_canary_verdict") == []
+        assert out.rejected == []
+        _assert_zero_dropped(out, wl)
+
+        # new-version references, computed on the shared ref engine
+        # with the candidate weights swapped in (and restored after)
+        _ref_mod.swap_params(params_v2)
+        try:
+            new_ref = {}
+            for req in wl.requests:
+                _ref_mod.reset()
+                sched = sv.ContinuousBatchingScheduler(_ref_mod,
+                                                       max_queue=4)
+                sched.submit(sv.Request(
+                    "ref", req.prompt,
+                    max_new_tokens=req.max_new_tokens,
+                    eos_id=req.eos_id, temperature=req.temperature,
+                    top_k=req.top_k, seed=req.seed))
+                new_ref[req.rid] = sched.run()["ref"].tokens
+        finally:
+            _ref_mod.swap_params(params)
+            _ref_mod.reset()
+
+        n_old = n_new = 0
+        for req in wl.requests:
+            got = out.results[req.rid].tokens
+            old = isolated_tokens(req)
+            if got == old:
+                n_old += 1
+            if got == new_ref[req.rid]:
+                n_new += 1
+            assert got == old or got == new_ref[req.rid], \
+                f"{req.rid} is a hybrid of two weight versions"
+        # the mixed window really mixed: both versions finished work,
+        # and the two references genuinely disagree somewhere
+        assert n_old >= 1 and n_new >= 1
+        assert any(isolated_tokens(r) != new_ref[r.rid]
+                   for r in wl.requests)
+
+        # mixed-version observability: routed events tagged with the
+        # serving step saw both versions during the window
+        routed_steps = {e.get("weights_step")
+                        for e in tap.of("serving_fleet_routed")}
+        assert {BOOT_STEP, TARGET} <= routed_steps
+        assert router.weights_steps == {"r0": TARGET, "r1": TARGET,
+                                        "r2": TARGET}
+
+
+# ---------------------------------------------------------------------------
+# chaos: the gate earns its keep
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutChaos:
+    def _run_regressing(self, engines, params, root, *, gated):
+        clk = sv.VirtualClock()
+        router = _mk_fleet(engines, clk)
+        reloaders = _mk_reloaders(router, root, params)
+        wl = _workload(seed_base=360, deadline_s=5.0)
+        with _EventTap() as tap, \
+                obs.recording_requests(clock=clk) as rec:
+            ctl = sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(
+                    step=TARGET, canary_fraction=0.5,
+                    canary_window_steps=12, health_window_steps=1,
+                    gate=(sv.CanaryGate() if gated else None)),
+                recorder=(rec if gated else None))
+            fault = RegressingWeights(ctl, slow_every=2)
+            ctl.start()
+            out = sv.LoadGenerator(router, wl, step_time_s=STEP_S,
+                                   step_hook=_chain(ctl, fault)).run()
+            _drive_to_terminal(router, clk, ctl, fault)
+        return router, ctl, fault, out, wl, tap
+
+    def test_regressing_candidate_gate_halts_and_rolls_back_bit_exact(
+            self, fleet_engines, params, tmp_path):
+        """The headline chaos: a candidate that validates clean but
+        serves measurably worse fails the canary gate → automatic halt
+        + fleet rollback leaves every replica BIT-EXACT on the old
+        weights — and the gated rollout's goodput strictly beats the
+        identical rollout with the gate disabled."""
+        bad = RegressingWeights.publish(str(tmp_path), params, TARGET)
+        router, ctl, fault, out, wl, tap = self._run_regressing(
+            fleet_engines, params, tmp_path, gated=True)
+
+        assert ctl.state == "aborted", ctl.status
+        assert ctl.abort_reason.startswith("canary_failed")
+        assert ctl.verdict is not None and not ctl.verdict.passed
+        assert fault.stalls > 0                 # the regression bit
+        # halt + rollback: ONE replica (the canary) had upgraded; it
+        # rolled back and the whole fleet serves the old bytes again
+        rb = tap.of("serving_rollout_rolled_back")
+        assert [(e["replicas"], e["names"]) for e in rb] == [(1, "r0")]
+        assert len(tap.of("serving_rollout_halted")) == 1
+        assert tap.of("serving_rollout_promoted") == []
+        assert router.weights_steps == {"r0": BOOT_STEP,
+                                        "r1": BOOT_STEP,
+                                        "r2": BOOT_STEP}
+        for e in fleet_engines:
+            assert _tree_bytes_equal(e.params, params), \
+                "rollback was not bit-exact"
+            assert not _tree_bytes_equal(e.params, bad)
+        # the fleet kept serving throughout: zero admitted drops
+        _assert_zero_dropped(out, wl)
+        g_gated = out.goodput
+        assert g_gated is not None
+
+        # the honesty baseline: same candidate, same chaos, gate OFF —
+        # the regression promotes fleet-wide and goodput pays for it
+        for e in fleet_engines:
+            e.swap_params(params)
+            e.reset()
+        router0, ctl0, fault0, out0, wl0, _ = self._run_regressing(
+            fleet_engines, params, tmp_path, gated=False)
+        assert ctl0.state == "promoted"         # nothing stopped it
+        assert router0.weights_steps == {"r0": TARGET, "r1": TARGET,
+                                         "r2": TARGET}
+        for e in fleet_engines:
+            assert _tree_bytes_equal(e.params, bad)
+        assert fault0.stalls > fault.stalls     # whole fleet degraded
+        _assert_zero_dropped(out0, wl0)
+        g_ungated = out0.goodput
+        assert g_ungated is not None
+        assert g_gated > g_ungated, \
+            f"gated goodput {g_gated} vs ungated {g_ungated}"
+
+    def test_corrupt_candidate_mid_rollout_refused_and_rolled_back(
+            self, fleet_engines, params, tmp_path, isolated_tokens):
+        """The candidate's bytes rot AFTER the canary upgraded: the
+        next wave's reload refuses first-class, the rollout halts, and
+        the already-upgraded canary rolls back bit-exact — the fleet
+        never serves corrupt weights and never drops a stream."""
+        rz.save_checkpoint(str(tmp_path), TARGET, {"params": params})
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        wl = _workload(seed_base=380)
+        fault = CorruptCandidateMidRollout(str(tmp_path), TARGET,
+                                           at_step=6)
+        with _EventTap() as tap, \
+                obs.recording_requests(clock=clk) as rec:
+            ctl = sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(
+                    step=TARGET, canary_fraction=0.34,
+                    canary_window_steps=10, health_window_steps=1,
+                    gate=sv.CanaryGate(ttft_ratio=3.0,
+                                       completion_margin=0.5)),
+                recorder=rec)
+            ctl.start()
+            out = sv.LoadGenerator(router, wl, step_time_s=STEP_S,
+                                   step_hook=_chain(ctl, fault)).run()
+            _drive_to_terminal(router, clk, ctl, fault)
+
+        assert fault.corrupted
+        assert ctl.state == "aborted", ctl.status
+        assert "reload_refused:r1" in ctl.abort_reason
+        # the canary passed its gate BEFORE the corruption landed on
+        # the next wave — the verdict is not what halted this rollout
+        assert ctl.verdict is not None and ctl.verdict.passed
+        rb = tap.of("serving_rollout_rolled_back")
+        assert [(e["replicas"], e["names"]) for e in rb] == [(1, "r0")]
+        assert router.weights_steps == {"r0": BOOT_STEP,
+                                        "r1": BOOT_STEP,
+                                        "r2": BOOT_STEP}
+        for e in fleet_engines:
+            assert _tree_bytes_equal(e.params, params)
+        _assert_zero_dropped(out, wl)
+        for req in wl.requests:
+            if req.rid in out.results:
+                assert out.results[req.rid].tokens \
+                    == isolated_tokens(req)
+        for e in fleet_engines:
+            assert e.decode_compiles() == 1
+
+    def test_kill_canary_mid_window_aborts_and_replays_losslessly(
+            self, fleet_engines, params, tmp_path, isolated_tokens):
+        """The canary dies mid-verdict-window: the rollout halts
+        (replica death outranks the verdict), there is nothing live to
+        roll back, and every canary stream replays losslessly on the
+        old-version survivors — zero dropped, token-identical."""
+        rz.save_checkpoint(str(tmp_path), TARGET, {"params": params})
+        clk = sv.VirtualClock()
+        router = _mk_fleet(fleet_engines, clk)
+        reloaders = _mk_reloaders(router, tmp_path, params)
+        wl = _workload(seed_base=400)
+        with _EventTap() as tap, \
+                obs.recording_requests(clock=clk) as rec:
+            ctl = sv.RollingReloadController(
+                router, reloaders,
+                config=sv.RolloutConfig(
+                    step=TARGET, canary_fraction=0.5,
+                    canary_window_steps=10, health_window_steps=1,
+                    gate=sv.CanaryGate(completion_margin=0.5)),
+                recorder=rec)
+            fault = KillCanary(ctl, after_window_steps=2)
+            ctl.start()
+            out = sv.LoadGenerator(router, wl, step_time_s=STEP_S,
+                                   step_hook=_chain(ctl, fault)).run()
+            _drive_to_terminal(router, clk, ctl, fault)
+
+        assert fault.killed
+        assert ctl.state == "aborted", ctl.status
+        assert ctl.abort_reason == "replica_died:r0"
+        assert router.state_of("r0") is sv.ReplicaState.DEAD
+        assert router.replicas_healthy == 2
+        # the dead canary cannot roll back (its scheduler is closed);
+        # no OTHER replica had upgraded, so the rollback set is empty
+        rb = tap.of("serving_rollout_rolled_back")
+        assert [e["replicas"] for e in rb] == [0]
+        assert len(tap.of("serving_rollout_halted")) == 1
+        assert router.weights_steps["r1"] == BOOT_STEP
+        assert router.weights_steps["r2"] == BOOT_STEP
+        # the pin died with the rollout: the window log was drained
+        assert router.unpin_traffic() == {}
+        # lossless: every admitted stream — the canary's included —
+        # finished with full service, token-identical to its reference
+        _assert_zero_dropped(out, wl)
+        for req in wl.requests:
+            if req.rid in out.results and out.results[req.rid] \
+                    .finish_reason in sv.SERVED_REASONS:
+                assert out.results[req.rid].tokens \
+                    == isolated_tokens(req)

@@ -184,6 +184,8 @@ def test_tp_speculation_verify_parity(model, params):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow   # ~4 s: tier-1 keeps the dense/paged lossless
+# preemption witnesses in test_serving_policy.py and tp2 greedy identity
 def test_tp_preempt_resume_within_engine_bit_exact(model, params):
     """Lossless preemption on the sharded engine: capture → release →
     restore → resumed prefill → decode continues the stream exactly as
@@ -231,6 +233,8 @@ def test_tp_preempt_resume_within_engine_bit_exact(model, params):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow   # ~6 s: tier-1 keeps the dense prefix-hit trajectory
+# witness in test_serving_prefix.py and tp2 greedy stream identity
 def test_tp_prefix_cache_hit_stream_parity(model, params):
     """The scheduler's prefix-cache path over a tp engine: the second
     request admits via a cache hit (capture gathered the sharded K/V,
@@ -267,6 +271,8 @@ def test_tp_prefix_cache_hit_stream_parity(model, params):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow   # ~5 s: tier-1 keeps the CoW both-ways bit-isolation
+# witness in test_serving_paged.py — this is its tp composition variant
 def test_tp_paged_fork_cow_stream_parity(model, params):
     ref = sv.DecodeEngine(model, params, slots=4, max_len=MAX,
                           prefill_len=16,
@@ -338,6 +344,8 @@ def test_tp_weights_restore_onto_mesh_v1(model, params, tmp_path):
     assert s_tp == s_ref
 
 
+@pytest.mark.slow   # ~4 s: tier-1 keeps the v1-manifest witness of the
+# same restore-onto-mesh claim
 def test_tp_weights_restore_onto_mesh_v2(model, params, tmp_path):
     from jax.sharding import Mesh
 

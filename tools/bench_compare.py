@@ -150,6 +150,13 @@ def classify(path: str) -> Optional[str]:
     # policy/SLO blocks
     if "serving_fleet" in segments and segments[-1] == "shed":
         return "lower"
+    # family-scoped override: inside the serving_rollout block, halt/
+    # abort/rollback counts and the per-replica swap pause are GRADED
+    # outcomes (a rollout that halts or pauses more regressed — the
+    # clean-path terminal grades through "ok", zero-tolerance)
+    if "serving_rollout" in segments and segments[-1] in (
+            "aborts", "halts", "rollbacks", "pause"):
+        return "lower"
     if segments[-1] in _INFORMATIONAL_EXACT:
         return None
     for seg in reversed(segments):

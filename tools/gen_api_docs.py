@@ -98,6 +98,7 @@ PAGES = {
         "apex_tpu.serving.weights",
         "apex_tpu.serving.reload",
         "apex_tpu.serving.fleet",
+        "apex_tpu.serving.rollout",
     ]),
     "observability": ("Observability (metrics, spans, exporters)", [
         "apex_tpu.obs", "apex_tpu.obs.metrics", "apex_tpu.obs.trace",
@@ -187,7 +188,13 @@ def _render_symbol(name: str, obj) -> list[str]:
         if d:
             lines.append(d + "\n")
     else:  # data export (e.g. enum instance, constant)
-        lines.append(f"### `{name}` = `{_ADDR_RE.sub('', repr(obj))}`\n")
+        if isinstance(obj, (set, frozenset)):
+            # set reprs are hash-order dependent; sort for stable docs
+            body = ", ".join(repr(x) for x in sorted(obj, key=repr))
+            rendered = f"{type(obj).__name__}({{{body}}})"
+        else:
+            rendered = _ADDR_RE.sub("", repr(obj))
+        lines.append(f"### `{name}` = `{rendered}`\n")
     return lines
 
 
@@ -1096,6 +1103,63 @@ aggregate.
 `bench.py`'s `serving_fleet` block records the failover latency, the
 replica-loss throughput ratio, and the failover-on vs -off goodput
 delta on identical chaos.
+
+## Rolling upgrades & canary (`serving.rollout`)
+
+`RollingReloadController` orchestrates the fleet-wide weight upgrade
+the reload + fleet primitives were built for, with zero dropped
+streams — per replica: `prefetch()` the candidate off the serving
+path → `drain()` (lossless evacuation to survivors) → `reload()`
+consuming the stage (swap-only pause) → `rejoin()`, K replicas per
+wave.
+
+- **Health-gate semantics**: between waves the rejoined replicas must
+  be HEALTHY for `health_window_steps` **consecutive** clean router
+  steps — a SUSPECT beat resets the count (clean-eventually is not
+  clean), and a replica death anywhere mid-rollout aborts.  The gate
+  bounds the blast radius: at most one wave is ever unproven.
+- **Canary**: the first upgraded replica serves a seeded
+  deterministic `canary_fraction` of new traffic
+  (`FleetRouter.pin_traffic`, the shadow/A-B `assign_arm` rid hash —
+  an exact reproducible split, not a statistical one) for
+  `canary_window_steps`; the router's pin log then splits the
+  window's request records into arms and `CanaryGate` compares the
+  canary's `SLOReport` against the old-version baseline (tpot/ttft
+  p95 ratios, completion rate, goodput when deadlines are known).
+  The gate **fails closed**: a canary that served too few samples
+  fails.  Pass promotes the rollout to the remaining replicas;
+  fail — or a refused/corrupt candidate — halts it.
+- **Rollback exactness**: abort rolls every upgraded replica back
+  newest-first via `HotReloader.rollback()`, which swaps back the
+  *displaced buffer itself* — the very arrays that were serving
+  before the upgrade, retained in the double buffer, never copied
+  through a checkpoint round-trip — so a halted rollout leaves the
+  fleet serving **bit-identical** weights to the pre-rollout state
+  (chaos-pinned).  `rollback()` also discards any staged prefetch
+  from the abandoned version (`stats["discarded_stages"]`), so a
+  later reload cannot silently re-promote it.
+- **Mixed-version caveats**: mid-rollout the fleet serves two
+  versions.  `weights_step` rides every routed/finished event and
+  `StreamExport`, and the router refuses to resume a captured
+  (KV-intact) stream on a *different-version* survivor — it degrades
+  to a bare requeue whose deterministic replay re-earns the tokens
+  end-to-end on ONE version.  No stream is ever a hybrid of two
+  models; the cost is honest (re-decode), the consistency is
+  absolute.
+- **Chaos**: `CorruptCandidateMidRollout` (candidate bytes rot after
+  commit → reload refuses → halt), `RegressingWeights` (validates
+  clean, serves measurably worse — only the canary gate catches it),
+  and `KillCanary` (canary dies mid-window → halt + rollback), all
+  riding `LoadGenerator(step_hook=)`.
+
+Observability: `serving_rollout_{started,replica_upgraded,
+canary_verdict,halted,rolled_back,promoted}` events feed
+`apex_serving_rollout_*` metrics (in-flight gauge, upgrade/verdict/
+halt/rollback/promotion counters, swap-pause + verdict-latency +
+rollout-wall histograms).  `bench.py`'s `serving_rollout` block
+records rollout wall, per-replica swap pause, dropped streams (must
+be 0), and verdict latency; the gate-on vs gate-off goodput delta
+under a regressing candidate is the gate's measured value.
 """,
     "observability": """\
 Answer "what is my p99 step time, queue depth, or TTFT right now"
@@ -1182,6 +1246,15 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_fleet_resumes_total` | counter | `serving_fleet_resumed` events with mode=`capture-resume` — victims landed on a survivor with captured cache intact (bit-exact mid-stream) |
 | `apex_serving_fleet_shed_total` | counter | `serving_fleet_shed` events — requests the fleet shed (all healthy queues full, no replica, or unabsorbed failover victims) |
 | `apex_serving_fleet_failover_seconds` | histogram | `serving_fleet_resumed` events — replica failure (or drain) to survivor landing, per stream, on the fleet's shared clock |
+| `apex_serving_rollout_active` | gauge | 1 while a rolling fleet upgrade is in flight (`serving_rollout_started` sets, the promoted/halted terminal clears) |
+| `apex_serving_rollout_replicas_upgraded_total` | counter | `serving_rollout_replica_upgraded` events — replicas that completed drain → reload → rejoin |
+| `apex_serving_rollout_verdicts_total{verdict}` | counter | `serving_rollout_canary_verdict` events — canary gate decisions (`pass` promotes, `fail` halts) |
+| `apex_serving_rollout_halts_total` | counter | `serving_rollout_halted` events — rollouts halted before promotion (gate failure, refused candidate, replica death) |
+| `apex_serving_rollout_rollbacks_total` | counter | `serving_rollout_rolled_back` events — replicas rolled back byte-exact from their retained previous buffer |
+| `apex_serving_rollout_promotions_total` | counter | `serving_rollout_promoted` events — rollouts that converged the whole fleet on the new `weights_step` |
+| `apex_serving_rollout_swap_pause_seconds` | histogram | `serving_rollout_replica_upgraded` events — per-replica serving pause (pointer swap only; restore/validate ran off-path via prefetch) |
+| `apex_serving_rollout_verdict_latency_seconds` | histogram | `serving_rollout_canary_verdict` events — canary window open (traffic pinned) to gate verdict, shared clock |
+| `apex_serving_rollout_wall_seconds` | histogram | `serving_rollout_halted`/`serving_rollout_promoted` events — rollout start to terminal, shared clock |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -1877,6 +1950,48 @@ failover.  The fleet publishes `apex_serving_fleet_*` metrics
 failure→resume latency histogram); `bench.py`'s `serving_fleet` block
 records the measured failover latency and the failover-on vs -off
 goodput delta in `PERF_NOTES.md`.
+
+Upgrade the fleet with zero dropped streams — a rolling, health-gated
+weight upgrade with a canary replica and automatic fleet rollback
+([full page](api/serving.md)):
+
+```python
+from apex_tpu import serving as sv
+from apex_tpu import obs
+
+reloaders = {name: sv.HotReloader(sched, ckpt_root, like=state,
+                                  params_key="params",
+                                  current_step=100)
+             for name, sched in replicas.items()}
+with obs.recording_requests(clock=clock) as rec:
+    ctl = sv.RollingReloadController(
+        router, reloaders,
+        config=sv.RolloutConfig(
+            health_window_steps=2,     # clean steps between waves
+            canary_fraction=0.25,      # pinned to the first upgrade
+            canary_window_steps=16,    # then the gate decides
+            gate=sv.CanaryGate(tpot_ratio=1.5)),
+        recorder=rec)
+    ctl.start(step=200)                # newest committed by default
+    out = sv.LoadGenerator(router, wl, step_hook=ctl).run()
+
+assert ctl.state == "promoted"         # or "aborted" + abort_reason
+assert set(router.weights_steps.values()) == {200}
+```
+
+Per replica the controller runs `prefetch()` (restore+validate
+off-path) → `drain()` (streams move to survivors losslessly) →
+`reload()` (swap-only pause) → `rejoin()`, waiting for consecutive
+clean HEALTHY steps between waves.  The canary serves a seeded exact
+traffic fraction and must beat the old-version arms' SLO report; a
+gate failure, refused candidate, or replica death halts the rollout
+and rolls every upgraded replica back **bit-exactly** from its
+retained previous buffer.  Mid-rollout the fleet is mixed-version:
+`weights_step` rides every routed/finished event, and a captured
+stream never resumes across versions (it degrades to a deterministic
+same-version replay) — no hybrid streams, ever.  Chaos coverage:
+`CorruptCandidateMidRollout`, `RegressingWeights` (validates clean,
+serves worse — only the gate catches it), `KillCanary`.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),

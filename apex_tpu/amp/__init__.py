@@ -31,9 +31,10 @@ from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
-from apex_tpu.amp import functional, lists
+from apex_tpu.amp import functional, lists, quant
 from apex_tpu.amp.functional import active_policy, set_active_policy
 from apex_tpu.amp.policy import O0, O1, O2, O3, PrecisionPolicy, get_policy
+from apex_tpu.amp.quant import INT8_QMAX, dequantize_int8, quantize_int8
 from apex_tpu.amp.scaler import LossScaler, LossScalerState, static_loss_scaler
 
 __all__ = [
@@ -41,6 +42,10 @@ __all__ = [
     "AmpState",
     "functional",
     "lists",
+    "quant",
+    "INT8_QMAX",
+    "quantize_int8",
+    "dequantize_int8",
     "active_policy",
     "set_active_policy",
     "PrecisionPolicy",

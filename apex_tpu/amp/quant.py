@@ -1,0 +1,88 @@
+"""Per-channel symmetric int8 quantize/dequantize primitives.
+
+The ONE spelling site for the serving quantization subsystem
+(:mod:`apex_tpu.serving.quant`) and any future training use: everything
+that turns a float tensor into an ``(int8 payload, fp32 scale)`` pair —
+weight tensors at load time, KV-cache rows at append time, allreduce
+operands mid-collective — goes through these two functions, so the
+rounding convention, the clip range, and the zero-row guard are defined
+exactly once (unit-tested against a numpy oracle in
+``tests/test_serving_quant.py``).
+
+Convention (the symmetric scheme EQuARX and the int8 serving
+literature share):
+
+- **Symmetric, zero-point-free**: ``q = round(x / scale)`` clipped to
+  ``[-127, 127]`` — the -128 code is unused, so negation and the
+  dequant ``q * scale`` are exact mirrors and no zero-point arithmetic
+  rides the hot path.
+- **Per-channel scales**: ``scale = amax(|x|) / 127`` reduced over the
+  caller-chosen ``axis`` (the non-channel axes).  A weight ``[in,
+  out]`` quantized over ``axis=0`` gets one fp32 scale per output
+  channel; a KV row ``[..., kv_heads, head_dim]`` quantized over
+  ``axis=-1`` gets one scale per (position, head).
+- **Zero-amax guard**: an all-zero group takes ``scale = 1.0`` (not 0,
+  which would NaN the dequant; not an epsilon, which would manufacture
+  denormals) — the payload is all zeros either way, so the roundtrip
+  is exact.
+- **fp32 scales**: scale precision bounds the whole scheme's error;
+  half-precision scales would double the relative scale error for a
+  byte nobody is short of (the scale tensor is smaller than the
+  payload by the group size).
+
+Roundtrip property the serving capture/restore path leans on: because
+the group's amax element quantizes to exactly ±127,
+``quantize(dequantize(q, s))`` reproduces ``q`` bit-for-bit and ``s``
+to within 1 ulp — see ``serving/quant.py`` for the argument.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["INT8_QMAX", "quantize_int8", "dequantize_int8"]
+
+# symmetric clip bound: ±127, the -128 code deliberately unused
+INT8_QMAX = 127.0
+
+
+def _norm_axes(axis: Union[int, Tuple[int, ...]], ndim: int
+               ) -> Tuple[int, ...]:
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    out = tuple(sorted(a % ndim for a in axes))
+    if len(set(out)) != len(out):
+        raise ValueError(f"duplicate quantization axes {axes}")
+    return out
+
+
+def quantize_int8(x, axis: Union[int, Tuple[int, ...]] = -1
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-channel int8 quantization.
+
+    ``axis`` (int or tuple) names the dimensions the amax reduces
+    *over* — the remaining dimensions are the channels, one fp32 scale
+    each.  Returns ``(q, scale)`` with ``q`` int8 shaped like ``x`` and
+    ``scale`` fp32 shaped like ``x`` with the reduced axes removed, so
+    ``dequantize_int8(q, scale, axis)`` restores ``x``'s shape.
+    """
+    axes = _norm_axes(axis, jnp.ndim(x))
+    xf = jnp.asarray(x).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale), -INT8_QMAX,
+                 INT8_QMAX).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=axes)
+
+
+def dequantize_int8(q, scale, axis: Union[int, Tuple[int, ...]] = -1,
+                    dtype=jnp.float32) -> jax.Array:
+    """Exact symmetric dequant: ``q * scale`` with the scale broadcast
+    back over the reduced ``axis`` positions (the same ``axis`` the
+    matching :func:`quantize_int8` call used), cast to ``dtype``."""
+    axes = _norm_axes(axis, jnp.ndim(q))
+    s = jnp.expand_dims(jnp.asarray(scale, jnp.float32), axes)
+    out = q.astype(jnp.float32) * s
+    return out if dtype == jnp.float32 else out.astype(dtype)

@@ -93,3 +93,8 @@ register("serving_fleet", "fault-tolerant fleet serving: prefix-affinity/WRR "
          "(bit-exact capture-resume or deterministic replay), rolling drain, "
          "and replica-scale chaos (kill/wedge/slow)",
          False, "host-side router over N scheduler replicas")
+register("serving_quant", "quantized serving: per-channel int8 weights, "
+         "per-(position, head) int8 KV cache (dense + paged), and opt-in "
+         "grouped-scale int8 tp allreduce — greedy-agreement tier vs fp32, "
+         "default-off byte-identical, same bounded program families",
+         False, "jnp/XLA int8 inside the existing jitted serving programs")

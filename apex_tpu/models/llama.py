@@ -360,8 +360,12 @@ class LlamaAttention(nn.Module):
             # [max_len]-extent view back out of the pool via a
             # fixed-extent gather — identical values at every unmasked
             # position, identical reduction extents, hence bit-identical
-            # logits (the dense-vs-paged parity contract)
-            paged = isinstance(kv_cache, pkv.PagedKVCache)
+            # logits (the dense-vs-paged parity contract).  The KV-int8
+            # twins ride the same branches: the cache primitives are
+            # polymorphic (quant caches dequantize inside the read), so
+            # attention itself never spells a scale
+            paged = isinstance(kv_cache,
+                               (pkv.PagedKVCache, pkv.QuantPagedKVCache))
             if decode:
                 # append this token per slot, then attend over the whole
                 # masked cache (post-rope K, like the uncached path sees)
@@ -379,8 +383,12 @@ class LlamaAttention(nn.Module):
                     kv_cache = kvc.append_token(
                         kv_cache, layer_idx, k[0], v[0],
                         jnp.asarray(position))
-                    kc = kv_cache.k[layer_idx].astype(q.dtype)  # [b,max,nkv,hd]
-                    vc = kv_cache.v[layer_idx].astype(q.dtype)
+                    # decode_read is the fp buffer rows verbatim (same
+                    # trace as indexing .k directly) or the dequantized
+                    # KV-int8 view — [b, max, nkv, hd] either way
+                    kc, vc = kvc.decode_read(kv_cache, layer_idx)
+                    kc = kc.astype(q.dtype)
+                    vc = vc.astype(q.dtype)
                 if nkv != nq:
                     rep = nq // nkv
                     kc = jnp.repeat(kc, rep, axis=2)
@@ -410,12 +418,11 @@ class LlamaAttention(nn.Module):
                     kv_cache = kvc.prefill_into_slot(
                         kv_cache, layer_idx, slot, k[:, 0], v[:, 0],
                         start=offset)
-                    kc = jax.lax.dynamic_index_in_dim(
-                        kv_cache.k[layer_idx], jnp.asarray(slot, jnp.int32),
-                        axis=0, keepdims=False).astype(q.dtype)  # [max,nkv,hd]
-                    vc = jax.lax.dynamic_index_in_dim(
-                        kv_cache.v[layer_idx], jnp.asarray(slot, jnp.int32),
-                        axis=0, keepdims=False).astype(q.dtype)
+                    # slot_read: the same dynamic_index_in_dim gather as
+                    # before for an fp cache, dequantized for KV-int8
+                    kc, vc = kvc.slot_read(kv_cache, layer_idx, slot)
+                    kc = kc.astype(q.dtype)         # [max, nkv, hd]
+                    vc = vc.astype(q.dtype)
                 if nkv != nq:
                     rep = nq // nkv
                     kc = jnp.repeat(kc, rep, axis=1)

@@ -261,6 +261,24 @@ SERVING_ROLLOUT_WALL_SECONDS = metrics.histogram(
     "apex_serving_rollout_wall_seconds",
     "rollout start to terminal (promoted or halted+rolled back), on "
     "the fleet's shared clock")
+SERVING_QUANT_BYTES_PER_TOKEN = metrics.gauge(
+    "apex_serving_quant_bytes_per_token",
+    "KV-cache bytes pinned per cached token position under the active "
+    "quantization config (int8 payload + fp32 scales; fp32 serving "
+    "reports its plain payload bytes — the capacity denominator behind "
+    "streams-per-GB)")
+SERVING_QUANT_LOGIT_ERROR = metrics.histogram(
+    "apex_serving_quant_logit_error",
+    "max |fp32 logit - quantized logit| per quant evaluation window "
+    "(dimensionless logit-space distance; the numeric-drift companion "
+    "to the token-agreement gauge)",
+    buckets=tuple(float(b) for b in (0.001, 0.0025, 0.005, 0.01, 0.025,
+                                     0.05, 0.1, 0.25, 0.5, 1.0)))
+SERVING_QUANT_AGREEMENT = metrics.gauge(
+    "apex_serving_quant_agreement_ratio",
+    "greedy token-stream agreement of the quantized engine against its "
+    "fp32 reference over the most recent evaluation window (1.0 == "
+    "bit-identical token stream)")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
@@ -476,6 +494,18 @@ def _on_serving_rollout_rolled_back(event: dict) -> None:
         SERVING_ROLLOUT_ROLLBACKS.inc(replicas)
 
 
+def _on_serving_quant_eval(event: dict) -> None:
+    agreement = _measurement(event, "agreement")
+    if agreement is not None and 0 <= agreement <= 1:
+        SERVING_QUANT_AGREEMENT.set(agreement)
+    err = _measurement(event, "max_logit_error")
+    if err is not None:
+        SERVING_QUANT_LOGIT_ERROR.observe(err)
+    bpt = _measurement(event, "bytes_per_token")
+    if bpt is not None:
+        SERVING_QUANT_BYTES_PER_TOKEN.set(bpt)
+
+
 def _on_serving_rollout_promoted(event: dict) -> None:
     SERVING_ROLLOUT_PROMOTIONS.inc()
     SERVING_ROLLOUT_ACTIVE.set(0)
@@ -520,6 +550,7 @@ _HANDLERS = {
     "serving_rollout_halted": _on_serving_rollout_halted,
     "serving_rollout_rolled_back": _on_serving_rollout_rolled_back,
     "serving_rollout_promoted": _on_serving_rollout_promoted,
+    "serving_quant_eval": _on_serving_quant_eval,
 }
 
 

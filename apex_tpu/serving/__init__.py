@@ -89,6 +89,17 @@ head matmul), its amp policies, and its resilience checkpoints:
   :class:`apex_tpu.obs.RequestTraceRecorder` +
   :func:`apex_tpu.obs.build_report` for p50/p95/p99 TTFT / TPOT /
   queue-wait and goodput SLO reports.
+- :mod:`.quant` — **quantized serving** (``DecodeEngine(...,
+  quant=QuantConfig(...))``, default off): int8 weights (per-output-
+  channel scales, dequant fused into the existing jitted program
+  families — no new compiles), int8 KV cache (per-(position, head)
+  scales beside the dense slots or the paged block pool; capture hands
+  out dequantized fp32 so prefix caching, speculation, preemption, and
+  fleet failover stay quantization-oblivious), and an opt-in grouped-
+  scale int8 tp allreduce for the per-layer psum pair.  Acceptance is
+  agreement-tier: pinned greedy-stream agreement + bounded per-
+  position logit error vs the fp32 engine, and ≥1.8x decode streams
+  per byte of KV budget.
 - :mod:`.weights` — :func:`load_serving_params`: newest *valid* step
   from a resilience checkpoint root (v1 whole-tree and v2 sharded both
   work), params subtree selection, bf16 serving casts through
@@ -152,12 +163,15 @@ from apex_tpu.serving.engine import (
 )
 from apex_tpu.serving.kv_cache import (
     KVCache,
+    QuantKVCache,
     append_token,
     init_cache,
+    init_quant_cache,
     prefill_into_slot,
     read_slot_region,
     release_slot,
     valid_token_mask,
+    value_dtype,
     write_slot_region,
 )
 from apex_tpu.serving.paged_kv_cache import (
@@ -165,7 +179,23 @@ from apex_tpu.serving.paged_kv_cache import (
     PagedCacheConfig,
     PagedCacheManager,
     PagedKVCache,
+    QuantPagedKVCache,
     init_paged_cache,
+    init_quant_paged_cache,
+)
+from apex_tpu.serving.quant import (
+    QTensor,
+    QuantConfig,
+    dequant_params,
+    evaluate_quant,
+    is_quantized,
+    kv_bytes_per_token,
+    max_logit_error,
+    param_bytes,
+    quantize_params,
+    quantized_allreduce,
+    serving_param_spec,
+    stream_agreement,
 )
 from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
@@ -209,7 +239,24 @@ __all__ = [
     "PagedCacheConfig",
     "PagedCacheManager",
     "PagedKVCache",
+    "QuantKVCache",
+    "QuantPagedKVCache",
     "init_paged_cache",
+    "init_quant_cache",
+    "init_quant_paged_cache",
+    "value_dtype",
+    "QTensor",
+    "QuantConfig",
+    "dequant_params",
+    "evaluate_quant",
+    "is_quantized",
+    "kv_bytes_per_token",
+    "max_logit_error",
+    "param_bytes",
+    "quantize_params",
+    "quantized_allreduce",
+    "serving_param_spec",
+    "stream_agreement",
     "PrefixCache",
     "PrefixCacheConfig",
     "DecodeEngine",

@@ -69,16 +69,29 @@ from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.serving.kv_cache import (
     KVCache,
     commit_slot_length,
+    gather_slot_rows,
     init_cache,
+    init_quant_cache,
     release_slot,
+    value_dtype,
     write_slot_region,
 )
 from apex_tpu.serving.paged_kv_cache import (
     PagedCacheConfig,
     PagedCacheManager,
     PagedKVCache,
+    QuantPagedKVCache,
     blocks_per_slot,
     init_paged_cache,
+    init_quant_paged_cache,
+)
+from apex_tpu.serving.quant import (
+    QuantConfig,
+    dequant_params,
+    is_quantized,
+    quantize_params,
+    quantized_allreduce,
+    serving_param_spec,
 )
 from apex_tpu.utils.compat import (
     NO_REP_CHECK,
@@ -123,11 +136,13 @@ def tp_param_shardings(params, mesh) -> "jax.tree_util.PyTreeDef":
     model owns its column/row layout).  Hand this to
     :func:`apex_tpu.serving.weights.load_serving_params` to restore a
     checkpoint *directly onto the serving mesh* — no host-replicated
-    detour — or ``jax.device_put`` a host tree with it."""
-    from apex_tpu.models.llama import tp_param_spec
-
+    detour — or ``jax.device_put`` a host tree with it.  Quant-aware:
+    a weight-quantized tree's QTensor payload/scale leaves get the
+    layout :func:`apex_tpu.serving.quant.serving_param_spec` derives
+    from the kernel they replaced (plain fp leaves keep the exact
+    ``tp_param_spec`` layout as before)."""
     return jax.tree_util.tree_map_with_path(
-        lambda path, _: NamedSharding(mesh, tp_param_spec(
+        lambda path, _: NamedSharding(mesh, serving_param_spec(
             path, SERVING_TP_AXIS)), params)
 
 
@@ -242,7 +257,8 @@ class DecodeEngine:
                  draft_buckets: Optional[Sequence[int]] = None,
                  cache_dtype=None,
                  paged: Optional[PagedCacheConfig] = None,
-                 tp: Optional[TPConfig] = None):
+                 tp: Optional[TPConfig] = None,
+                 quant: Optional[QuantConfig] = None):
         if prefill_len < 2:
             raise ValueError("prefill_len must be >= 2 (a length-1 "
                              "prefill is indistinguishable from a decode "
@@ -284,6 +300,22 @@ class DecodeEngine:
                 f"largest draft bucket {dbuckets[-1]} must be < max_len "
                 f"{max_len} (a verify writes bucket+1 rows into the "
                 f"cache)")
+        # opt-in quantized serving: validate the lever combination up
+        # front (quant=None keeps every code path below byte-for-byte
+        # untouched — same traces, same events, same token bytes)
+        self._quant_cfg = quant
+        if quant is not None:
+            if quant.allreduce and tp is None:
+                raise ValueError(
+                    "QuantConfig(allreduce=True) without tp= — the "
+                    "quantized collective replaces the per-layer tp "
+                    "psum pair; a single-chip engine has no psum to "
+                    "quantize")
+            if quant.kv and cache_dtype is not None:
+                raise ValueError(
+                    "cache_dtype with QuantConfig(kv=True) — the KV-"
+                    "int8 cache owns its storage dtype (int8 payload "
+                    "+ fp32 scales); drop one of the two")
         self.model = model
         self.params = params
         self.slots = int(slots)
@@ -308,6 +340,14 @@ class DecodeEngine:
                       if hasattr(l, "dtype")
                       and jnp.issubdtype(l.dtype, jnp.floating)]
             cache_dtype = floats[0] if floats else jnp.float32
+        # weight-int8 at boot, AFTER the cache dtype inference (the
+        # quantized tree's fp leaves are the scales — inferring from
+        # them would serve a bf16 model with an f32 cache).  A pre-
+        # quantized tree (load_serving_params(quantize=True), or a
+        # rollback buffer) passes through untouched.
+        if quant is not None and quant.weights and not is_quantized(params):
+            params = quantize_params(params)
+            self.params = params
         # opt-in paged layout: a global block pool + per-slot block
         # tables, host-managed by a PagedCacheManager (allocation,
         # refcounts, CoW planning).  None (the default) keeps the dense
@@ -334,12 +374,22 @@ class DecodeEngine:
         # but pjit specializes a SECOND executable for the changed
         # placement, and the "compiles bounded by the bucket table"
         # contract would be off by one (environment-dependently)
+        kv_int8 = quant is not None and quant.kv
         if self._pager is not None:
-            fresh = init_paged_cache(
-                model.config, slots=slots, max_len=max_len,
-                block_size=self._pager.block_size,
-                num_blocks=self._pager.num_blocks, dtype=cache_dtype)
+            fresh = (init_quant_paged_cache(
+                         model.config, slots=slots, max_len=max_len,
+                         block_size=self._pager.block_size,
+                         num_blocks=self._pager.num_blocks)
+                     if kv_int8 else
+                     init_paged_cache(
+                         model.config, slots=slots, max_len=max_len,
+                         block_size=self._pager.block_size,
+                         num_blocks=self._pager.num_blocks,
+                         dtype=cache_dtype))
             self._pager.consume_dirty()     # device holds this snapshot
+        elif kv_int8:
+            fresh = init_quant_cache(model.config, slots=slots,
+                                     max_len=max_len)
         else:
             fresh = init_cache(model.config, slots=slots, max_len=max_len,
                                dtype=cache_dtype)
@@ -371,10 +421,15 @@ class DecodeEngine:
             # no trailing None: jit outputs carry the canonical short
             # spec, and the init-time placement must hash identically
             # or the first post-decode prefill retraces
+            # the KV-int8 scale arrays (dense [layers, slots, max_len,
+            # kv_heads], paged pools [layers, blocks, block_size,
+            # kv_heads]) carry kv_heads on axis 3 exactly like the
+            # payload, so one spec covers all four fields
             kvspec = P(None, None, None, SERVING_TP_AXIS)
             self._cache_specs = jax.tree_util.tree_map_with_path(
                 lambda path, _: (kvspec
-                                 if jax.tree_util.keystr(path) in (".k", ".v")
+                                 if jax.tree_util.keystr(path) in
+                                 (".k", ".v", ".k_scale", ".v_scale")
                                  else P()), fresh)
             self._host_target = NamedSharding(self._mesh, P())
             # restore/read chunks are [layers, rows, kv_heads, head_dim]
@@ -407,6 +462,18 @@ class DecodeEngine:
         # rollback bookkeeping) can tell which weights produced a byte
         self._weights_version = 0
 
+        # weight-int8: every program body expands QTensor leaves back
+        # to fp INSIDE its jit (XLA fuses the int8*scale read into the
+        # surrounding matmul; the HBM-resident tree stays int8).  The
+        # off path binds the identity — the traced graph is the byte-
+        # identical fp graph, so quant=None engines keep every compile
+        # and numerics contract untouched.
+        if quant is not None and quant.weights:
+            dq = dequant_params
+        else:
+            def dq(p):
+                return p
+
         def _prefill(params, cache, ids, slot, offset, length):
             # ids [1, B] (one bucket's shape — jit compiles one program
             # per bucket, never per prompt length); offset = tokens
@@ -414,7 +481,7 @@ class DecodeEngine:
             # chunk.  Returns the logits at the chunk's last real
             # position (the next-token distribution after the final
             # chunk) + the filled cache.
-            logits, cache = model.apply(params, ids, kv_cache=cache,
+            logits, cache = model.apply(dq(params), ids, kv_cache=cache,
                                         slot=slot, position=offset)
             cache = commit_slot_length(cache, slot, offset + length)
             last = lax.dynamic_index_in_dim(logits[:, 0, :], length - 1,
@@ -433,12 +500,12 @@ class DecodeEngine:
             # branch is on the cache's pytree type — a trace-time
             # constant, so each engine still compiles exactly one
             # decode program and the dense trace is untouched.
-            if isinstance(cache, PagedKVCache):
+            if isinstance(cache, (PagedKVCache, QuantPagedKVCache)):
                 position = jnp.where(active, cache.lengths,
                                      jnp.int32(-1))
             else:
                 position = cache.lengths
-            logits, cache = model.apply(params, tokens[:, None],
+            logits, cache = model.apply(dq(params), tokens[:, None],
                                         kv_cache=cache, position=position)
             cache = dataclasses.replace(
                 cache,
@@ -461,7 +528,7 @@ class DecodeEngine:
             # count), and the length commit rolls the slot back to
             # offset + a + 1 — the rejected rows' K/V become unreadable
             # in the same program that wrote them.
-            logits, cache = model.apply(params, ids, kv_cache=cache,
+            logits, cache = model.apply(dq(params), ids, kv_cache=cache,
                                         slot=slot, position=offset)
             rows = logits[:, 0, :].astype(jnp.float32)   # [W, vocab]
             if tp is not None:
@@ -504,20 +571,54 @@ class DecodeEngine:
                                              keepdims=False)
             v_blk = lax.dynamic_index_in_dim(cache.v, s, axis=1,
                                              keepdims=False)
-            return dataclasses.replace(
-                cache,
-                k=cache.k.at[:, d].set(k_blk),
-                v=cache.v.at[:, d].set(v_blk))
+            new = dict(k=cache.k.at[:, d].set(k_blk),
+                       v=cache.v.at[:, d].set(v_blk))
+            if isinstance(cache, QuantPagedKVCache):
+                # a KV-int8 block's bytes are payload + scales: a CoW
+                # that copied one without the other would dequantize
+                # the writer's copy through the sharers' scales —
+                # trace-time dispatch, same single compiled program
+                new["k_scale"] = cache.k_scale.at[:, d].set(
+                    lax.dynamic_index_in_dim(cache.k_scale, s, axis=1,
+                                             keepdims=False))
+                new["v_scale"] = cache.v_scale.at[:, d].set(
+                    lax.dynamic_index_in_dim(cache.v_scale, s, axis=1,
+                                             keepdims=False))
+            return dataclasses.replace(cache, **new)
 
         def _read(cache, slot, start, *, n):
             # the traced-start twin of kv_cache.read_slot_region (same
             # row gather; the module primitive takes host ints while a
             # capture wants ONE compiled program for every block offset
-            # — static extent, traced start)
+            # — static extent, traced start).  gather_slot_rows hands a
+            # KV-int8 cache's rows back DEQUANTIZED fp32, so prefix
+            # capture and preemption snapshots stay quant-oblivious.
             rows = jnp.asarray(start, jnp.int32) + jnp.arange(
                 n, dtype=jnp.int32)
-            s = jnp.asarray(slot, jnp.int32)
-            return cache.k[:, s, rows], cache.v[:, s, rows]
+            return gather_slot_rows(cache, slot, rows)
+
+        if quant is not None and quant.allreduce:
+            # grouped-scale int8 psum: the override is TRACE-time state
+            # (reduce_from consults it while the body's jaxpr is built),
+            # and jit runs the python body exactly once per program
+            # family/shape — so wrapping the bodies swaps the collective
+            # into every traced program while the executed XLA keeps no
+            # python in the loop.  Scoped to kind="row_linear": only the
+            # per-layer o_proj/down_proj psum pair quantizes; embedding
+            # and logits reductions stay exact.
+            from apex_tpu.transformer.tensor_parallel.mappings import (
+                override_forward_allreduce,
+            )
+
+            def _with_quant_psum(body):
+                def wrapped(*args):
+                    with override_forward_allreduce(quantized_allreduce):
+                        return body(*args)
+                return wrapped
+
+            _prefill = _with_quant_psum(_prefill)
+            _decode = _with_quant_psum(_decode)
+            _verify = _with_quant_psum(_verify)
 
         # the cache argument is donated: the engine discards the old
         # functional copy on every call, and without aliasing each
@@ -541,13 +642,15 @@ class DecodeEngine:
             # needs no serving-specific branches, and each family still
             # compiles the same bounded program count (asserted in
             # tests/test_serving_tp.py via the same compile witnesses).
-            from apex_tpu.models.llama import tp_param_spec
             P = PartitionSpec
             TP = SERVING_TP_AXIS
             mesh = self._mesh
             cspec = self._cache_specs
+            # serving_param_spec == tp_param_spec on fp leaves; QTensor
+            # payload/scale leaves get the layout derived from the
+            # kernel they replaced
             pspec = jax.tree_util.tree_map_with_path(
-                lambda path, _: tp_param_spec(path, TP), params)
+                lambda path, _: serving_param_spec(path, TP), params)
             blk = P(None, None, TP, None)   # [layers, rows, kvh, hd]
             S = P()                         # replicated scalars/ids
 
@@ -586,12 +689,24 @@ class DecodeEngine:
         logger.debug("DecodeEngine: slots=%d max_len=%d prefill_len=%d "
                      "buckets=%s cache_dtype=%s", self.slots,
                      self.max_len, self.prefill_len,
-                     self.prefill_buckets, jnp.dtype(cache_dtype).name)
+                     self.prefill_buckets, jnp.dtype(fresh.dtype).name)
+        if quant is not None:
+            # quant=None emits nothing: the default-off event stream is
+            # byte-identical to the fp engine's
+            emit_event("serving_quant_enabled",
+                       weights=bool(quant.weights), kv=bool(quant.kv),
+                       allreduce=bool(quant.allreduce), tp=self.tp_size,
+                       paged=self._pager is not None)
 
     # ---- cache/slot state ------------------------------------------------
     @property
     def cache(self) -> KVCache:
         return self._cache
+
+    @property
+    def quant(self) -> Optional[QuantConfig]:
+        """The quantization config, or ``None`` on an fp engine."""
+        return self._quant_cfg
 
     @property
     def tp(self) -> Optional[TPConfig]:
@@ -691,6 +806,14 @@ class DecodeEngine:
         the engine is between dispatches at every scheduler step
         boundary, which is the only place a reloader calls this.
         """
+        if (self._quant_cfg is not None and self._quant_cfg.weights
+                and not is_quantized(params)):
+            # a reloader hands the engine a freshly restored fp tree;
+            # quantize it the same way boot did so the structural check
+            # below compares like with like.  An already-quantized
+            # candidate (the rollback buffer swap_params itself
+            # returned) passes through untouched.
+            params = quantize_params(params)
         old_leaves, old_def = jax.tree_util.tree_flatten(self.params)
         new_leaves, new_def = jax.tree_util.tree_flatten(params)
         if new_def != old_def:
@@ -1173,7 +1296,10 @@ class DecodeEngine:
                 f"restored prefix of {length} tokens leaves no room in "
                 f"a max_len={self.max_len} cache for the resume chunk "
                 f"that must produce the next-token logits")
-        dtype = self._cache.dtype
+        # the VALUE dtype, not the storage dtype: staging a restore
+        # chunk in a KV-int8 cache's int8 payload dtype would crush the
+        # captured fp rows to garbage before the in-program requantize
+        dtype = value_dtype(self._cache)
         for start in range(0, length, self.prefill_len):
             n = min(self.prefill_len, length - start)
             bucket = self.bucket_for(n)

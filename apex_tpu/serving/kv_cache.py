@@ -48,9 +48,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["KVCache", "init_cache", "prefill_into_slot", "append_token",
-           "commit_slot_length", "release_slot", "valid_token_mask",
-           "read_slot_region", "write_slot_region"]
+from apex_tpu.amp.quant import dequantize_int8, quantize_int8
+
+__all__ = ["KVCache", "QuantKVCache", "init_cache", "init_quant_cache",
+           "prefill_into_slot", "append_token", "commit_slot_length",
+           "release_slot", "valid_token_mask", "read_slot_region",
+           "write_slot_region", "decode_read", "slot_read", "value_dtype",
+           "gather_slot_rows"]
 
 
 @functools.partial(jax.tree_util.register_dataclass,
@@ -84,6 +88,61 @@ class KVCache:
         return self.k.dtype
 
 
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=("k", "v", "k_scale", "v_scale", "lengths"),
+                   meta_fields=())
+@dataclasses.dataclass(frozen=True)
+class QuantKVCache:
+    """KV-int8 twin of :class:`KVCache`: same slot-indexed layout, the
+    payload stored as symmetric int8 with one fp32 scale per cached
+    (position, head) — the per-token-per-head grouping that keeps a
+    long-tailed row from crushing its neighbors' resolution while the
+    scale overhead stays ``4 / head_dim`` of the fp32 bytes.
+
+    ``k`` / ``v``: int8 ``[layers, slots, max_len, kv_heads,
+    head_dim]``; ``k_scale`` / ``v_scale``: fp32 ``[layers, slots,
+    max_len, kv_heads]``; ``lengths``: ``[slots]`` int32.  Every
+    masking/length/drop-scatter contract of the fp cache holds
+    unchanged — the scale arrays ride the same row indices as the
+    payload, and under tensor parallelism they shard head-wise on the
+    SAME axis-3 spec (``P(None, None, None, 'tp')``) because kv_heads
+    sits at axis 3 in both layouts.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array
+    v_scale: jax.Array
+    lengths: jax.Array
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_slots(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def dtype(self):
+        """Payload dtype (int8) — see :func:`value_dtype` for the dtype
+        reads dequantize to."""
+        return self.k.dtype
+
+
+def value_dtype(cache) -> Any:
+    """The dtype cache *reads* produce: the payload dtype for fp
+    caches, fp32 (the dequant output) for quantized ones — what
+    restore/capture plumbing must use for staging buffers instead of
+    ``cache.dtype`` (int8 staging would destroy the values before the
+    in-program requantize)."""
+    return jnp.float32 if isinstance(cache, QuantKVCache) else cache.dtype
+
+
 def init_cache(config: Any, *, slots: int, max_len: int,
                dtype=jnp.float32) -> KVCache:
     """Zero-filled cache for ``config`` (a :class:`LlamaConfig`-shaped
@@ -94,6 +153,21 @@ def init_cache(config: Any, *, slots: int, max_len: int,
              head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
                    lengths=jnp.zeros((slots,), jnp.int32))
+
+
+def init_quant_cache(config: Any, *, slots: int,
+                     max_len: int) -> QuantKVCache:
+    """Zero-filled KV-int8 cache.  Scales start at 1.0 (the zero-amax
+    convention of :func:`apex_tpu.amp.quant.quantize_int8`): an unused
+    row dequantizes to exact finite zeros, never NaN."""
+    head_dim = config.hidden_size // config.num_attention_heads
+    shape = (config.num_hidden_layers, slots, max_len, config.kv_heads,
+             head_dim)
+    return QuantKVCache(
+        k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.ones(shape[:-1], jnp.float32),
+        v_scale=jnp.ones(shape[:-1], jnp.float32),
+        lengths=jnp.zeros((slots,), jnp.int32))
 
 
 def prefill_into_slot(cache: KVCache, layer: int, slot, k_seq, v_seq,
@@ -118,6 +192,18 @@ def prefill_into_slot(cache: KVCache, layer: int, slot, k_seq, v_seq,
     rows = jnp.asarray(start, jnp.int32) + jnp.arange(
         k_seq.shape[0], dtype=jnp.int32)
     s = jnp.asarray(slot, jnp.int32)
+    if isinstance(cache, QuantKVCache):
+        # per-(row, head) symmetric int8: the scale rows ride the same
+        # drop-safe scatter indices as the payload, so an overhanging
+        # padding row drops BOTH or NEITHER
+        kq, ks = quantize_int8(k_seq, axis=-1)
+        vq, vs = quantize_int8(v_seq, axis=-1)
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer, s, rows].set(kq, mode="drop"),
+            v=cache.v.at[layer, s, rows].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[layer, s, rows].set(ks, mode="drop"),
+            v_scale=cache.v_scale.at[layer, s, rows].set(vs, mode="drop"))
     return dataclasses.replace(
         cache,
         k=cache.k.at[layer, s, rows].set(k_seq.astype(cache.dtype),
@@ -140,7 +226,23 @@ def append_token(cache: KVCache, layer: int, k_tok, v_tok,
         return lax.dynamic_update_slice(
             buf, tok.astype(buf.dtype)[None], (pos, 0, 0))
 
+    def write_scale(buf, tok, pos):  # buf [max_len, kvh]
+        return lax.dynamic_update_slice(buf, tok[None], (pos, 0))
+
     pos = jnp.asarray(positions, jnp.int32)
+    if isinstance(cache, QuantKVCache):
+        kq, ks = quantize_int8(k_tok, axis=-1)    # [slots, kvh, hd] -> ..
+        vq, vs = quantize_int8(v_tok, axis=-1)    # .. + scale [slots, kvh]
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer].set(
+                jax.vmap(write_one)(cache.k[layer], kq, pos)),
+            v=cache.v.at[layer].set(
+                jax.vmap(write_one)(cache.v[layer], vq, pos)),
+            k_scale=cache.k_scale.at[layer].set(
+                jax.vmap(write_scale)(cache.k_scale[layer], ks, pos)),
+            v_scale=cache.v_scale.at[layer].set(
+                jax.vmap(write_scale)(cache.v_scale[layer], vs, pos)))
     return dataclasses.replace(
         cache,
         k=cache.k.at[layer].set(jax.vmap(write_one)(cache.k[layer], k_tok,
@@ -172,6 +274,16 @@ def read_slot_region(cache: KVCache, slot, start, stop) -> tuple:
         raise ValueError(f"empty region [{start}, {stop})")
     rows = jnp.asarray(start, jnp.int32) + jnp.arange(n, dtype=jnp.int32)
     s = jnp.asarray(slot, jnp.int32)
+    if isinstance(cache, QuantKVCache):
+        # capture hands out DEQUANTIZED fp32 rows: every host consumer
+        # (prefix-cache spans, preemption snapshots, fleet stream
+        # exports) stays quantization-oblivious, and the matching
+        # restore requantizes in-program — the int8 payload survives
+        # that roundtrip exactly (see serving/quant.py)
+        return (dequantize_int8(cache.k[:, s, rows],
+                                cache.k_scale[:, s, rows]),
+                dequantize_int8(cache.v[:, s, rows],
+                                cache.v_scale[:, s, rows]))
     return cache.k[:, s, rows], cache.v[:, s, rows]
 
 
@@ -195,6 +307,19 @@ def write_slot_region(cache: KVCache, slot, start, k_region,
     rows = jnp.asarray(start, jnp.int32) + jnp.arange(
         k_region.shape[1], dtype=jnp.int32)
     s = jnp.asarray(slot, jnp.int32)
+    if isinstance(cache, QuantKVCache):
+        # requantize the (dequantized-fp32) span in-program: the group
+        # amax element always requantizes to exactly ±127, so the int8
+        # payload is reproduced bit-for-bit and the scales to 1 ulp —
+        # restore-after-capture stays agreement-tier-exact
+        kq, ks = quantize_int8(k_region, axis=-1)
+        vq, vs = quantize_int8(v_region, axis=-1)
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[:, s, rows].set(kq, mode="drop"),
+            v=cache.v.at[:, s, rows].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[:, s, rows].set(ks, mode="drop"),
+            v_scale=cache.v_scale.at[:, s, rows].set(vs, mode="drop"))
     return dataclasses.replace(
         cache,
         k=cache.k.at[:, s, rows].set(k_region.astype(cache.dtype),
@@ -231,6 +356,55 @@ def release_slot(cache: KVCache, slot) -> KVCache:
     """
     return dataclasses.replace(
         cache, lengths=cache.lengths.at[jnp.asarray(slot)].set(0))
+
+
+def gather_slot_rows(cache, slot, rows):
+    """Gather one slot's K/V at explicit (traced) row indices across
+    every layer — the row-level read :func:`read_slot_region` and the
+    engine's traced-start region-read program share.  Returns
+    ``(k, v)`` of shape ``[layers, len(rows), kv_heads, head_dim]``;
+    a :class:`QuantKVCache` hands back DEQUANTIZED fp32 rows (host
+    consumers stay quantization-oblivious; the matching restore
+    requantizes in-program and the int8 payload survives the roundtrip
+    exactly)."""
+    s = jnp.asarray(slot, jnp.int32)
+    if isinstance(cache, QuantKVCache):
+        return (dequantize_int8(cache.k[:, s, rows],
+                                cache.k_scale[:, s, rows]),
+                dequantize_int8(cache.v[:, s, rows],
+                                cache.v_scale[:, s, rows]))
+    return cache.k[:, s, rows], cache.v[:, s, rows]
+
+
+def decode_read(cache, layer: int):
+    """The batched decode attention read: every slot's K/V for one
+    layer as ``[slots, max_len, kv_heads, head_dim]``.  An fp cache
+    hands back its buffer rows as-is; a :class:`QuantKVCache`
+    dequantizes through the per-(position, head) scales — same shapes,
+    same masked-read contract, fp32 values."""
+    if isinstance(cache, QuantKVCache):
+        return (dequantize_int8(cache.k[layer], cache.k_scale[layer]),
+                dequantize_int8(cache.v[layer], cache.v_scale[layer]))
+    return cache.k[layer], cache.v[layer]
+
+
+def slot_read(cache, layer: int, slot):
+    """One slot's K/V for one layer as ``[max_len, kv_heads,
+    head_dim]`` (``slot`` may be traced) — the chunked-prefill read,
+    dequantized for a :class:`QuantKVCache` exactly like
+    :func:`decode_read`."""
+    s = jnp.asarray(slot, jnp.int32)
+    k = lax.dynamic_index_in_dim(cache.k[layer], s, axis=0,
+                                 keepdims=False)
+    v = lax.dynamic_index_in_dim(cache.v[layer], s, axis=0,
+                                 keepdims=False)
+    if isinstance(cache, QuantKVCache):
+        ks = lax.dynamic_index_in_dim(cache.k_scale[layer], s, axis=0,
+                                      keepdims=False)
+        vs = lax.dynamic_index_in_dim(cache.v_scale[layer], s, axis=0,
+                                      keepdims=False)
+        return dequantize_int8(k, ks), dequantize_int8(v, vs)
+    return k, v
 
 
 def valid_token_mask(positions, max_len: int):

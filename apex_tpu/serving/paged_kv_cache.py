@@ -68,10 +68,13 @@ from jax import lax
 import numpy as np
 
 from apex_tpu._logging import get_logger
+from apex_tpu.amp.quant import dequantize_int8, quantize_int8
 
-__all__ = ["PagedCacheConfig", "PagedKVCache", "BlockPoolExhausted",
-           "PagedCacheManager", "init_paged_cache", "paged_prefill_write",
-           "paged_append", "decode_view", "prefill_view"]
+__all__ = ["PagedCacheConfig", "PagedKVCache", "QuantPagedKVCache",
+           "BlockPoolExhausted", "PagedCacheManager", "init_paged_cache",
+           "init_quant_paged_cache", "paged_prefill_write",
+           "paged_append", "decode_view", "prefill_view",
+           "bytes_per_block"]
 
 logger = get_logger("serving.paged_kv_cache")
 
@@ -157,9 +160,81 @@ class PagedKVCache:
         return self.k.dtype
 
 
+@functools.partial(jax.tree_util.register_dataclass,
+                   data_fields=("k", "v", "k_scale", "v_scale", "tables",
+                                "lengths"),
+                   meta_fields=("max_len",))
+@dataclasses.dataclass(frozen=True)
+class QuantPagedKVCache:
+    """KV-int8 twin of :class:`PagedKVCache`: the same block pool and
+    table routing, the payload stored as symmetric int8 with one fp32
+    scale per pooled (row, head) — scales live in a parallel pool
+    ``[layers, num_blocks, block_size, kv_heads]`` indexed by the SAME
+    block ids, so aliasing, CoW, fork, and release move payload and
+    scales together by construction (a shared block shares its scales;
+    a CoW copy copies both).
+
+    Every drop-safe-scatter/null-block/fixed-extent-gather invariant of
+    the fp pool holds unchanged; reads dequantize through the gathered
+    scales.  ``kv_heads`` sits at axis 3 of both pools, so under tensor
+    parallelism the scale pool shards on the same
+    ``P(None, None, None, 'tp')`` spec as the payload.
+    """
+
+    k: jax.Array
+    v: jax.Array
+    k_scale: jax.Array
+    v_scale: jax.Array
+    tables: jax.Array
+    lengths: jax.Array
+    max_len: int
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def num_slots(self) -> int:
+        return self.tables.shape[0]
+
+    @property
+    def blocks_per_slot(self) -> int:
+        return self.tables.shape[1]
+
+    @property
+    def dtype(self):
+        """Payload dtype (int8); reads dequantize to fp32."""
+        return self.k.dtype
+
+
 def blocks_per_slot(max_len: int, block_size: int) -> int:
     """Table width: blocks covering ``max_len`` rows (ceil division)."""
     return -(-int(max_len) // int(block_size))
+
+
+def bytes_per_block(cache) -> int:
+    """True resident bytes one pool block pins across every layer and
+    pool array.  For the fp pool that is the k+v payload; for the quant
+    pool the fp32 scale pools ride the same block ids, so their bytes
+    are part of the block (an accounting that read ``k.dtype.itemsize``
+    alone would undercount an int8 pool by its scale overhead)."""
+    pools = [cache.k, cache.v]
+    if isinstance(cache, QuantPagedKVCache):
+        pools += [cache.k_scale, cache.v_scale]
+    total = 0
+    for arr in pools:
+        shape = arr.shape            # [L, num_blocks, block_size, ...]
+        per = int(np.prod((shape[0],) + shape[2:]))
+        total += jnp.dtype(arr.dtype).itemsize * per
+    return int(total)
 
 
 def init_paged_cache(config: Any, *, slots: int, max_len: int,
@@ -173,6 +248,25 @@ def init_paged_cache(config: Any, *, slots: int, max_len: int,
     bps = blocks_per_slot(max_len, block_size)
     return PagedKVCache(
         k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        tables=jnp.zeros((slots, bps), jnp.int32),
+        lengths=jnp.zeros((slots,), jnp.int32), max_len=int(max_len))
+
+
+def init_quant_paged_cache(config: Any, *, slots: int, max_len: int,
+                           block_size: int,
+                           num_blocks: int) -> QuantPagedKVCache:
+    """Zero-filled KV-int8 block pool.  Scales start at 1.0 (the
+    zero-amax convention): the null block — and every unallocated block
+    — dequantizes to exact finite zeros, preserving the masked-read
+    ``0 * NaN``-safety invariant."""
+    head_dim = config.hidden_size // config.num_attention_heads
+    shape = (config.num_hidden_layers, num_blocks, block_size,
+             config.kv_heads, head_dim)
+    bps = blocks_per_slot(max_len, block_size)
+    return QuantPagedKVCache(
+        k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+        k_scale=jnp.ones(shape[:-1], jnp.float32),
+        v_scale=jnp.ones(shape[:-1], jnp.float32),
         tables=jnp.zeros((slots, bps), jnp.int32),
         lengths=jnp.zeros((slots,), jnp.int32), max_len=int(max_len))
 
@@ -229,6 +323,20 @@ def paged_prefill_write(cache: PagedKVCache, layer: int, slot, k_seq,
         cache.tables, jnp.asarray(slot, jnp.int32), axis=0,
         keepdims=False)
     phys, within = _route_rows(cache, table_row, rows)
+    if isinstance(cache, QuantPagedKVCache):
+        # scales scatter through the SAME (phys, within) routing as the
+        # payload: a dropped padding row drops both, a live row lands
+        # both in the same block
+        kq, ks = quantize_int8(k_seq, axis=-1)
+        vq, vs = quantize_int8(v_seq, axis=-1)
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer, phys, within].set(kq, mode="drop"),
+            v=cache.v.at[layer, phys, within].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[layer, phys, within].set(
+                ks, mode="drop"),
+            v_scale=cache.v_scale.at[layer, phys, within].set(
+                vs, mode="drop"))
     return dataclasses.replace(
         cache,
         k=cache.k.at[layer, phys, within].set(k_seq.astype(cache.dtype),
@@ -251,6 +359,17 @@ def paged_append(cache: PagedKVCache, layer: int, k_tok, v_tok,
     """
     pos = jnp.asarray(positions, jnp.int32)
     phys, within = _route_rows(cache, cache.tables, pos)
+    if isinstance(cache, QuantPagedKVCache):
+        kq, ks = quantize_int8(k_tok, axis=-1)
+        vq, vs = quantize_int8(v_tok, axis=-1)
+        return dataclasses.replace(
+            cache,
+            k=cache.k.at[layer, phys, within].set(kq, mode="drop"),
+            v=cache.v.at[layer, phys, within].set(vq, mode="drop"),
+            k_scale=cache.k_scale.at[layer, phys, within].set(
+                ks, mode="drop"),
+            v_scale=cache.v_scale.at[layer, phys, within].set(
+                vs, mode="drop"))
     return dataclasses.replace(
         cache,
         k=cache.k.at[layer, phys, within].set(k_tok.astype(cache.dtype),
@@ -271,22 +390,53 @@ def _gathered(cache: PagedKVCache, arr, tables) -> jax.Array:
     return flat[..., :cache.max_len, :, :]
 
 
-def decode_view(cache: PagedKVCache, layer: int
-                ) -> Tuple[jax.Array, jax.Array]:
+def _gathered_scale(cache, arr, tables) -> jax.Array:
+    """The scale-pool twin of :func:`_gathered`: ``arr[layer]`` rows
+    (``[num_blocks, block_size, kv_heads]`` — no head_dim axis)
+    gathered through ``tables`` and re-laid as contiguous token rows,
+    sliced to exactly ``max_len``."""
+    g = jnp.take(arr, tables, axis=0)     # [..., bps, bs, kvh]
+    flat = g.reshape(g.shape[:-3] + (g.shape[-3] * g.shape[-2],)
+                     + g.shape[-1:])
+    return flat[..., :cache.max_len, :]
+
+
+def decode_view(cache, layer: int) -> Tuple[jax.Array, jax.Array]:
     """Every slot's K/V as ``[slots, max_len, kv_heads, head_dim]`` —
     the batched decode read (same shape, same masked-read contract,
-    same reduction extents as the dense ``cache.k[layer]``)."""
+    same reduction extents as the dense ``cache.k[layer]``).  A
+    :class:`QuantPagedKVCache` dequantizes through the gathered
+    per-(row, head) scales; unallocated rows carry q=0/scale=1 and so
+    stay exact finite zeros."""
+    if isinstance(cache, QuantPagedKVCache):
+        return (dequantize_int8(
+                    _gathered(cache, cache.k[layer], cache.tables),
+                    _gathered_scale(cache, cache.k_scale[layer],
+                                    cache.tables)),
+                dequantize_int8(
+                    _gathered(cache, cache.v[layer], cache.tables),
+                    _gathered_scale(cache, cache.v_scale[layer],
+                                    cache.tables)))
     return (_gathered(cache, cache.k[layer], cache.tables),
             _gathered(cache, cache.v[layer], cache.tables))
 
 
-def prefill_view(cache: PagedKVCache, layer: int, slot
-                 ) -> Tuple[jax.Array, jax.Array]:
+def prefill_view(cache, layer: int, slot) -> Tuple[jax.Array, jax.Array]:
     """One slot's K/V as ``[max_len, kv_heads, head_dim]`` — the
-    chunked-prefill read (``slot`` may be traced)."""
+    chunked-prefill read (``slot`` may be traced), dequantized for a
+    :class:`QuantPagedKVCache` exactly like :func:`decode_view`."""
     table_row = lax.dynamic_index_in_dim(
         cache.tables, jnp.asarray(slot, jnp.int32), axis=0,
         keepdims=False)
+    if isinstance(cache, QuantPagedKVCache):
+        return (dequantize_int8(
+                    _gathered(cache, cache.k[layer], table_row),
+                    _gathered_scale(cache, cache.k_scale[layer],
+                                    table_row)),
+                dequantize_int8(
+                    _gathered(cache, cache.v[layer], table_row),
+                    _gathered_scale(cache, cache.v_scale[layer],
+                                    table_row)))
     return (_gathered(cache, cache.k[layer], table_row),
             _gathered(cache, cache.v[layer], table_row))
 

@@ -118,6 +118,9 @@ from apex_tpu.obs import bridge as obs_bridge
 from apex_tpu.serving.draft import SpeculationConfig, adapt_k, propose
 from apex_tpu.serving.engine import DecodeEngine, request_key
 from apex_tpu.serving.paged_kv_cache import blocks_per_slot
+from apex_tpu.serving.paged_kv_cache import (
+    bytes_per_block as pkv_bytes_per_block,
+)
 from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 
@@ -351,9 +354,11 @@ class ContinuousBatchingScheduler:
                     f"max_len={engine.max_len} cache alongside the "
                     f"resume token")
             if self._paged:
-                kshape = engine.cache.k.shape     # [L, nblk, bs, kvh, hd]
-                per_block = 2 * engine.cache.k.dtype.itemsize * int(
-                    np.prod((kshape[0],) + kshape[2:]))
+                # true per-block bytes — on a KV-int8 pool this counts
+                # the fp32 scale pools riding the same block ids, not
+                # just the int8 payload (pool-byte gauges and prefix
+                # eviction budgets would otherwise undercount ~20%)
+                per_block = pkv_bytes_per_block(engine.cache)
                 self._prefix = PrefixCache(
                     block_size=block,
                     max_tokens=prefix_caching.max_tokens,

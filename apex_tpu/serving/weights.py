@@ -123,7 +123,8 @@ def load_serving_params(root: str, like: Any, *,
                         params_key: Optional[str] = None,
                         policy: Any = None,
                         step: Optional[int] = None,
-                        shardings: Any = None) -> tuple[Any, int]:
+                        shardings: Any = None,
+                        quantize: bool = False) -> tuple[Any, int]:
     """Restore serving params from checkpoint ``root``.
 
     Args:
@@ -145,6 +146,13 @@ def load_serving_params(root: str, like: Any, *,
         :class:`~apex_tpu.serving.engine.DecodeEngine` transfers
         nothing.  With ``params_key`` set, ``like`` must be a mapping
         at the top level (the annotated params subtree is swapped in).
+      quantize: apply :func:`apex_tpu.serving.quant.quantize_params`
+        after the policy cast — projection kernels + LM head become
+        int8 :class:`~apex_tpu.serving.quant.QTensor` leaves, ready for
+        a ``quant=QuantConfig(weights=True)`` engine (which then skips
+        its own boot-time quantization).  ``shardings`` applies to the
+        *restored fp* tree; a tp engine re-lays the quantized leaves
+        out itself via its quant-aware param specs.
 
     Returns ``(params, step)``.  Raises :class:`CheckpointError` when no
     valid checkpoint exists (or the pinned step is invalid).
@@ -163,6 +171,10 @@ def load_serving_params(root: str, like: Any, *,
                 f"subtree to serve from") from e
     if policy is not None:
         tree = policy.cast_params(tree)
+    if quantize:
+        from apex_tpu.serving.quant import quantize_params
+
+        tree = quantize_params(tree)
     import jax
 
     nbytes = sum(int(getattr(leaf, "nbytes", 0))
@@ -175,5 +187,5 @@ def load_serving_params(root: str, like: Any, *,
                format_version=int(manifest.get("format_version", 1)),
                sharded=sharded, params_key=params_key,
                opt_level=getattr(policy, "opt_level", None),
-               bytes=nbytes, t0=t0)
+               quantized=bool(quantize), bytes=nbytes, t0=t0)
     return tree, got

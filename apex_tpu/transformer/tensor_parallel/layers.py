@@ -200,7 +200,13 @@ class RowParallelLinear(nn.Module):
             if self.sequence_parallel_enabled:
                 y = reduce_scatter_to_sequence_parallel_region(y, self.axis_name)
             else:
-                y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
+                # tagged "row_linear": this is the per-layer psum pair
+                # (attention o_proj + MLP down_proj) the serving quant
+                # subsystem may override with a grouped-scale int8
+                # allreduce; the embedding/logits reduces stay "generic"
+                # and therefore always exact
+                y = reduce_from_tensor_model_parallel_region(
+                    y, self.axis_name, kind="row_linear")
 
         if self.skip_bias_add:
             return y, bias

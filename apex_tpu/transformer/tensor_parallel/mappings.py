@@ -21,6 +21,7 @@ lower to XLA all-reduce / all-gather / reduce-scatter over ICI.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 
 import jax
@@ -36,6 +37,7 @@ __all__ = [
     "scatter_to_sequence_parallel_region",
     "gather_from_sequence_parallel_region",
     "reduce_scatter_to_sequence_parallel_region",
+    "override_forward_allreduce",
 ]
 
 
@@ -212,9 +214,55 @@ def copy_to_tensor_model_parallel_region(x, axis_name=None):
     return _copy_impl(x, axis_name) if _bound(axis_name) else x
 
 
-def reduce_from_tensor_model_parallel_region(x, axis_name=None):
-    """All-reduce fwd / identity bwd (the Megatron ``g``; mappings.py:164)."""
-    return _reduce_impl(x, axis_name) if _bound(axis_name) else x
+# trace-time forward-allreduce override: an opt-in replacement for the
+# Megatron ``g``'s forward psum, consulted per call-site ``kind``.  The
+# serving engine installs a quantized grouped-scale allreduce here for
+# the per-layer Row-parallel psum pair only (kind="row_linear") —
+# VocabParallelEmbedding's reduce keeps the default "generic" kind and
+# stays exact.  Forward-only by contract: an override is a serving
+# (inference) construct, so entering the scope around a traced autodiff
+# region is rejected by construction (the override fn carries no vjp).
+_FWD_ALLREDUCE_OVERRIDE: dict = {"fn": None, "kinds": ()}
+
+
+@contextlib.contextmanager
+def override_forward_allreduce(fn, kinds=("row_linear",)):
+    """Within the scope, :func:`reduce_from_tensor_model_parallel_region`
+    calls with a matching ``kind`` trace through ``fn(x, axis_name)``
+    instead of the exact psum.  Trace-time state: wrap the *tracing* of
+    a program (e.g. a ``shard_map`` body under ``jit``), not its
+    execution.  Not reentrant with a different fn on purpose — nested
+    conflicting overrides would make the traced collective ambiguous."""
+    prev = dict(_FWD_ALLREDUCE_OVERRIDE)
+    if (_FWD_ALLREDUCE_OVERRIDE["fn"] is not None
+            and _FWD_ALLREDUCE_OVERRIDE["fn"] is not fn):
+        raise RuntimeError(
+            "override_forward_allreduce is already active with a "
+            "different replacement — nested conflicting overrides are "
+            "not supported")
+    _FWD_ALLREDUCE_OVERRIDE["fn"] = fn
+    _FWD_ALLREDUCE_OVERRIDE["kinds"] = tuple(kinds)
+    try:
+        yield
+    finally:
+        _FWD_ALLREDUCE_OVERRIDE.update(prev)
+
+
+def reduce_from_tensor_model_parallel_region(x, axis_name=None, *,
+                                             kind="generic"):
+    """All-reduce fwd / identity bwd (the Megatron ``g``; mappings.py:164).
+
+    ``kind`` names the call site for the opt-in forward override
+    (:func:`override_forward_allreduce`): Row-parallel linears tag their
+    psum ``"row_linear"``; everything else defaults to ``"generic"``
+    and always takes the exact psum.
+    """
+    if not _bound(axis_name):
+        return x
+    fn = _FWD_ALLREDUCE_OVERRIDE["fn"]
+    if fn is not None and kind in _FWD_ALLREDUCE_OVERRIDE["kinds"]:
+        return fn(x, _axis(axis_name))
+    return _reduce_impl(x, axis_name)
 
 
 def scatter_to_tensor_model_parallel_region(x, axis_name=None):

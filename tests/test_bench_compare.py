@@ -50,6 +50,21 @@ GOLDEN_OLD = {
         "decode_compiles": 3,
         "config": {"kill_step": 4},
     },
+    "serving_quant": {
+        "ok": True,
+        "agreement": 1.0,
+        "max_logit_error": 0.04,
+        "capacity_ratio": 3.84,
+        "fp32": {"decode_ms_per_token": 4.0,
+                 "kv_bytes_per_token": 4608.0,
+                 "decode_compiles": 1},
+        "int8": {"decode_ms_per_token": 4.1,
+                 "kv_bytes_per_token": 1200.0,
+                 "decode_compiles": 1},
+        "agreement_ok": True,
+        "capacity_ok": True,
+        "config": {"slots": 4},
+    },
     "serving_rollout": {
         "ok": True,
         "replicas": 3,
@@ -167,6 +182,55 @@ class TestClassify:
         assert bc.classify(f"{base}.replicas") is None
         assert bc.classify(f"{base}.shed") is None
         assert bc.classify(f"{base}.config.canary_window_steps") is None
+
+    def test_quant_family_direction_aware(self):
+        """The ISSUE-19 serving_quant block: agreement and the
+        streams-per-GB capacity ratio grade higher, the logit drift
+        and cache bytes/token grade lower, the bar booleans flip
+        zero-tolerance, and compiles stay zero-tolerance — outside
+        the family the same leaf names stay unclassified."""
+        base = "serving_quant"
+        assert bc.classify(f"{base}.ok") == "exact_higher"
+        assert bc.classify(f"{base}.agreement") == "higher"
+        assert bc.classify(f"{base}.capacity_ratio") == "higher"
+        assert bc.classify(f"{base}.max_logit_error") == "lower"
+        assert bc.classify(f"{base}.int8.kv_bytes_per_token") == "lower"
+        assert bc.classify(f"{base}.fp32.kv_bytes_per_token") == "lower"
+        assert bc.classify(f"{base}.int8.decode_ms_per_token") == "lower"
+        assert bc.classify(f"{base}.int8.decode_compiles") == "exact"
+        assert bc.classify(f"{base}.agreement_ok") == "exact_higher"
+        assert bc.classify(f"{base}.capacity_ok") == "exact_higher"
+        assert bc.classify(f"{base}.config.slots") is None
+        # the override is family-scoped: the same names elsewhere are
+        # ungraded (agreement/bytes-per-token mean nothing generically)
+        assert bc.classify("serving.agreement") is None
+        assert bc.classify("serving.kv_bytes_per_token") is None
+        assert bc.classify("serving_slo.max_logit_error") is None
+
+    def test_quant_regressions_flagged(self):
+        worse = _mutated(**{"serving_quant.agreement": 0.80,
+                            "serving_quant.capacity_ratio": 1.5,
+                            "serving_quant.max_logit_error": 0.40,
+                            "serving_quant.int8.kv_bytes_per_token":
+                                2400.0,
+                            "serving_quant.int8.decode_compiles": 2})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        assert kinds["serving_quant.agreement"] == "regression"
+        assert kinds["serving_quant.capacity_ratio"] == "regression"
+        assert kinds["serving_quant.max_logit_error"] == "regression"
+        assert kinds["serving_quant.int8.kv_bytes_per_token"] == \
+            "regression"
+        # a new compile of a quant program family is a retrace, never
+        # noise
+        assert kinds["serving_quant.int8.decode_compiles"] == "regression"
+        flip = _mutated(**{"serving_quant.agreement_ok": False})
+        assert _kinds(bc.compare(GOLDEN_OLD, flip))[
+            "serving_quant.agreement_ok"] == "regression"
+        better = _mutated(**{"serving_quant.max_logit_error": 0.01,
+                             "serving_quant.capacity_ratio": 4.5})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, better))
+        assert kinds["serving_quant.max_logit_error"] == "improvement"
+        assert kinds["serving_quant.capacity_ratio"] == "improvement"
 
     def test_shed_graded_only_inside_fleet_family(self):
         """``shed`` is a workload-shape activity count everywhere else

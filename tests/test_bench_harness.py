@@ -314,6 +314,10 @@ def test_serving_metrics_block():
     assert r["config"]["slots"] == 4
 
 
+@pytest.mark.slow   # ~10 s: follows the spec/prefix/paged block-test
+# precedent — tp serving itself stays witnessed by tests/test_serving_tp.py
+# (stream identity, compile guards) and the block's grading by
+# tests/test_bench_compare.py golden fixtures
 def test_serving_tp_metrics_block():
     """The tensor-parallel serving block (ISSUE 15): tp=1 vs tp=2
     decode ms/token and aggregate tokens/s over one warmed engine pair,
@@ -476,6 +480,10 @@ def test_serving_paged_metrics_block():
     assert 1 <= r["prefill_compiles"] <= len(r["prefill_buckets"])
 
 
+@pytest.mark.slow   # ~11 s: follows the spec/prefix/paged/tp block-test
+# precedent — the SLO recorder/report surface stays witnessed by
+# tests/test_serving_slo.py and the policy contrast by
+# tests/test_serving_policy.py; block grading by bench_compare goldens
 def test_serving_slo_metrics_block():
     """The request-level SLO block (ISSUE 12): a seeded bursty
     open-loop workload at ~1x and ~2x the measured sustainable load,

@@ -45,6 +45,9 @@ def test_bert_pretrain_dp():
     assert "bert pretrain OK: dp=8" in out.stdout
 
 
+@pytest.mark.slow   # ~11 s: tier-1 keeps test_llama_pretrain_3d_tp_pp_dp,
+# which drives the same pretrain.py with tp AND pp AND dp axes live — the
+# 2-D tp×dp mesh is a strict subset of that witness
 def test_llama_pretrain_tp_dp():
     out = subprocess.run(
         [sys.executable, str(REPO / "examples" / "llama" / "pretrain.py"),
@@ -67,6 +70,11 @@ def _make_fake_imagefolder(root, classes=3, per_class=6, size=40):
             Image.fromarray(arr).save(d / f"img_{i}.jpg")
 
 
+@pytest.mark.slow   # ~13 s: the data-path machinery itself (ImageFolder,
+# PIL decode, augment, batching, worker pool) keeps its in-process tier-1
+# witnesses (test_batch_iterator_workers_matches_serial,
+# test_prefetch_loader_propagates_decode_errors); this subprocess rider
+# re-proves only the example's --data-dir flag wiring
 def test_imagenet_real_data_path(tmp_path):
     """--data-dir trains on a real image tree (VERDICT r3 item 8): PIL
     decode + augment + prefetch feeding the amp/DDP/FusedSGD step."""

@@ -239,6 +239,11 @@ def _run_sched(eng, *, speculation, requests):
     return results, sched, eng
 
 
+@pytest.mark.slow   # ~11 s: tier-1 keeps the engine-level verify parity
+# (test_spec_decode_bit_identical_with_rejection_and_neighbor_prefill)
+# plus the scheduler-driven spec streams in the eos / max_new_tokens /
+# temperature-bypass tests below — this three-request rerun re-proves
+# the same stream identity at larger token counts
 def test_scheduler_spec_streams_identical_in_fewer_steps(eng_pair):
     reqs = lambda: [                                   # noqa: E731
         sv.Request("greedy_rep", _rep_prompt(), max_new_tokens=40),

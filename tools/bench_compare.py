@@ -157,6 +157,20 @@ def classify(path: str) -> Optional[str]:
     if "serving_rollout" in segments and segments[-1] in (
             "aborts", "halts", "rollbacks", "pause"):
         return "lower"
+    # family-scoped override: inside the serving_quant block the graded
+    # directions are explicit — greedy-stream agreement vs fp32 must
+    # not drop (higher), the quantization costs (logit-space drift,
+    # cache bytes pinned per token) must not grow (lower), and the
+    # bar booleans flip zero-tolerance like ok flags.  capacity_ratio,
+    # *_ms_per_token, and compiles already ride the generic families.
+    if "serving_quant" in segments:
+        if segments[-1] == "agreement":
+            return "higher"
+        if segments[-1] in ("agreement_ok", "capacity_ok"):
+            return "exact_higher"
+        if (segments[-1] == "max_logit_error"
+                or "bytes_per_token" in segments[-1]):
+            return "lower"
     if segments[-1] in _INFORMATIONAL_EXACT:
         return None
     for seg in reversed(segments):

@@ -88,6 +88,10 @@ ALLOWLIST = {
     "loadgen_started",
     "loadgen_finished",
     "loadgen_request_shed",
+    # boot-time narration of which quantization legs are on — a config
+    # echo with no measurement; the quant metrics (agreement, logit
+    # error, bytes/token) ride serving_quant_eval, which IS handled
+    "serving_quant_enabled",
 }
 
 

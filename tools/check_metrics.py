@@ -44,8 +44,12 @@ _METRIC_FUNCS = {"counter", "gauge", "histogram"}
 _NAME_RE = re.compile(r"^apex_[a-z0-9_]+$")
 # _tokens joined for the speculative-decode acceptance-length
 # histogram: token counts are a real unit on the serving path, and a
-# forced _seconds name would lie about what the samples measure
-_UNIT_SUFFIXES = ("_seconds", "_bytes", "_tokens")
+# forced _seconds name would lie about what the samples measure.
+# _error joined for the quantized-serving logit-error histogram: the
+# samples are max |logit_fp32 - logit_int8| per evaluation — a
+# dimensionless logit-space distance, where any physical-unit suffix
+# would misstate what the distribution holds
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_tokens", "_error")
 
 
 class Registration(NamedTuple):

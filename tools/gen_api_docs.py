@@ -31,6 +31,7 @@ PAGES = {
     "amp": ("Mixed precision (amp)", [
         "apex_tpu.amp", "apex_tpu.amp.policy", "apex_tpu.amp.scaler",
         "apex_tpu.amp.lists", "apex_tpu.amp.functional",
+        "apex_tpu.amp.quant",
         "apex_tpu.fp16_utils",
     ]),
     "optimizers": ("Fused optimizers", [
@@ -91,6 +92,7 @@ PAGES = {
     "serving": ("Serving (KV-cached decode + continuous batching)", [
         "apex_tpu.serving", "apex_tpu.serving.kv_cache",
         "apex_tpu.serving.paged_kv_cache",
+        "apex_tpu.serving.quant",
         "apex_tpu.serving.engine", "apex_tpu.serving.draft",
         "apex_tpu.serving.prefix_cache",
         "apex_tpu.serving.scheduler", "apex_tpu.serving.policy",
@@ -793,6 +795,79 @@ wiring is deliberately thin — the *same* program bodies, wrapped in
   materializes a host-replicated copy of a model that only fits
   sharded.
 
+## Quantized serving (`quant=QuantConfig(...)`)
+
+`DecodeEngine(..., quant=QuantConfig(weights=True, kv=True,
+allreduce=False))` turns on int8 serving leg by leg; the default
+`quant=None` leaves every path **byte-for-byte** untouched — same
+token streams, same event/metric sequences, same compile counts
+(tier-1 pins the identity).  All three legs use ONE int8 convention,
+spelled exactly once in `apex_tpu.amp.quant`: symmetric, `scale =
+amax / 127` fp32 per group, zero-amax groups take scale 1.0 (so
+all-zero rows roundtrip to exact zeros, never NaN).
+
+- **Weight int8** (`weights=True`): at engine construction (or ahead
+  of time via `load_serving_params(..., quantize=True)` /
+  `serving.quant.quantize_params`) the seven projection kernels and
+  the LM head become `QTensor` leaves — int8 payload + one fp32 scale
+  per **output channel** (reduce axis 0 for `[in, out]` kernels, axis
+  1 for the `[vocab, hidden]` tied head).  Embedding, norms, and
+  biases stay high-precision: they are small, and norm numerics
+  gate stability.  Dequantization happens *inside* the existing
+  jitted program bodies (`dequant_params` at trace time), so the
+  program-family budget is unchanged — same prefill bucket table, one
+  decode program, `compile_count`-asserted.  ~4× less HBM per kernel
+  read; the per-channel scale keeps greedy streams at agreement tier.
+- **KV int8** (`kv=True`): the dense cache and the paged block pool
+  store int8 payloads with one fp32 scale per cached **(position,
+  kv-head)** (`QuantKVCache` / `QuantPagedKVCache`; scale pools are
+  indexed by the same slot rows / pool block ids as the payload, so
+  aliasing, CoW, fork, and release move payload and scales together
+  *by construction*).  Every drop-safe-scatter / null-block /
+  fixed-extent-gather invariant holds unchanged; unallocated rows
+  dequantize to exact finite zeros (scales initialize to 1.0), so
+  masked reads stay NaN-free.  Capture (`capture_slot` /
+  `read_region`) returns **dequantized fp32** — the prefix cache,
+  preemption, fleet failover, and every other host-side byte path stay
+  quantization-oblivious — and restore requantizes in-program; because
+  a group's amax element requantizes to exactly ±127, capture →
+  restore reproduces the stored payload bit-for-bit.  The cache
+  footprint drops from `2 · head_dim · 4` to `2 · (head_dim + 4)`
+  bytes per (position, kv-head) — ≥ 1.8× more streams per GB at
+  transformer head widths (3.84× at head_dim 96), the `serving_quant`
+  bench bar.
+- **Quantized tp allreduce** (`allreduce=True`, requires `tp=`): the
+  per-layer psum pair (row-parallel o_proj + down_proj) runs as
+  quantize → all_gather(int8 payload + per-group fp32 scales) →
+  dequant-sum, EQuARX-style — the compression playbook for the
+  latency-bound decode collective.  Scoped by construction to exactly
+  those reduces (`override_forward_allreduce(...,
+  kinds=("row_linear",))`): the vocab-parallel embedding psum and the
+  logits path stay exact, so the argmax tier is disturbed as little
+  as possible.  This is the one knowingly *lossy-per-step* leg and is
+  off by default inside `QuantConfig`.
+
+**Accuracy contract — agreement tier, not bit tier.**  Quantization
+is a real rounding step, so the fp-exactness ladder above does not
+apply; the pinned claim is **greedy token-stream agreement** against
+the fp32 reference (`serving.quant.stream_agreement`, bench bar on a
+pinned workload) plus bounded per-position logit error
+(`serving.quant.max_logit_error`).  *Within* the quantized
+configuration every structural guarantee still holds bit-for-bit:
+chunked prefill ≡ one-shot, paged ≡ dense, speculation ≡ plain decode,
+capture/restore ≡ uninterrupted — the same argument as fp32 (same
+bytes, same extents, same op sequence), just over int8 bytes.
+`serving.quant.evaluate_quant` packages the acceptance measurement and
+emits `serving_quant_eval`, feeding the
+`apex_serving_quant_agreement_ratio` gauge, the
+`apex_serving_quant_logit_error` histogram, and the
+`apex_serving_quant_bytes_per_token` gauge; engines log a one-shot
+`serving_quant_enabled` config echo at boot.  `bench.py`'s
+`serving_quant` block records decode ms/token fp32 vs int8, KV
+bytes/token, streams-per-GB capacity ratio (bar ≥ 1.8×), greedy
+agreement (bar ≥ 0.98), and the compile counts (zero tolerance on
+regression, graded direction-aware by `tools/bench_compare.py`).
+
 ## Determinism guarantees
 
 - **Prefill and greedy decode are bit-identical to the uncached
@@ -1255,6 +1330,9 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_rollout_swap_pause_seconds` | histogram | `serving_rollout_replica_upgraded` events — per-replica serving pause (pointer swap only; restore/validate ran off-path via prefetch) |
 | `apex_serving_rollout_verdict_latency_seconds` | histogram | `serving_rollout_canary_verdict` events — canary window open (traffic pinned) to gate verdict, shared clock |
 | `apex_serving_rollout_wall_seconds` | histogram | `serving_rollout_halted`/`serving_rollout_promoted` events — rollout start to terminal, shared clock |
+| `apex_serving_quant_bytes_per_token` | gauge | `serving_quant_eval` events — KV bytes pinned per cached token under the active quant config (int8 payload + fp32 scales; the streams-per-GB denominator) |
+| `apex_serving_quant_logit_error` | histogram | `serving_quant_eval` events — max \\|fp32 − quantized\\| logit distance per evaluation window (dimensionless) |
+| `apex_serving_quant_agreement_ratio` | gauge | `serving_quant_eval` events — greedy token-stream agreement vs the fp32 reference over the latest window (1.0 == identical stream) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
 
 ## Exposition formats
@@ -1642,6 +1720,30 @@ sched = sv.ContinuousBatchingScheduler(eng, max_queue=64,
                                        prefill_budget=256)
 # (on CPU, export XLA_FLAGS=--xla_force_host_platform_device_count=8
 #  before jax initializes to rehearse the mesh without TPUs)
+```
+
+Serve in int8 — when HBM, not FLOPs, caps how many streams fit, opt
+the same engine into quantized serving: per-output-channel int8
+projection kernels (norms/embedding stay high-precision), a
+per-(position, head)-scaled int8 KV cache (dense or paged — ≥ 1.8×
+more streams per GB), and optionally an EQuARX-style int8 tp
+allreduce for the latency-bound decode collective.  The default
+`quant=None` is byte-for-byte off; on, the claim is greedy-stream
+*agreement* with fp32 (measured, not assumed), and every structural
+guarantee — chunked prefill, speculation, capture/restore, CoW —
+still holds bit-for-bit *within* the quantized engine
+([full page](api/serving.md)):
+
+```python
+params, step = sv.load_serving_params(
+    "/ckpts/run7", like=template, params_key="params",
+    quantize=True)                       # int8 QTensor kernels at load
+eng = sv.DecodeEngine(model, params, slots=32, max_len=2048,
+                      prefill_len=256,
+                      quant=sv.QuantConfig(weights=True, kv=True))
+report = sv.evaluate_quant(ref_tokens, quant_tokens,
+                           bytes_per_token=sv.kv_bytes_per_token(
+                               eng.cache))   # -> agreement gauge et al.
 ```
 
 Slots admit from the bounded FIFO queue at every step boundary and free

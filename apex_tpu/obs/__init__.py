@@ -22,7 +22,14 @@ that makes it measurable in-process instead of via log grep:
   (queued → admitted → prefill chunks → first token → decode →
   finished, with exact phase durations and prefix/speculation
   annotations), exported as one-track-per-request Perfetto traces and
-  JSONL.  Default-off: no recorder installed ⇒ nothing runs.
+  JSONL.  Default-off: no recorder installed ⇒ nothing runs.  Fleet
+  runs add replica hop trails, per-replica timeline lanes, and
+  health/rollout bands to the same export.
+- :mod:`apex_tpu.obs.alerts` — a deterministic alert engine over
+  registry snapshots: threshold / absence / multi-window SLO burn-rate
+  rules with for-duration hysteresis, evaluated at the fleet step
+  boundary on the serving clock, with a bit-reproducible
+  firing→resolved ledger.  Default-off: no engine ⇒ no events.
 - :mod:`apex_tpu.obs.slo` — SLO percentile reports over those records:
   nearest-rank p50/p95/p99 TTFT / TPOT / queue-wait from exact
   samples, goodput against per-request deadlines, cross-checked
@@ -37,7 +44,15 @@ exporter attached the per-update overhead is a lock + dict write
 (``bench.py``'s ``obs`` block keeps it honest).
 """
 
-from apex_tpu.obs import bridge, metrics, request_trace, slo, trace
+from apex_tpu.obs import alerts, bridge, metrics, request_trace, slo, trace
+from apex_tpu.obs.alerts import (
+    AbsenceRule,
+    AlertEngine,
+    BurnRateRule,
+    Condition,
+    Selector,
+    ThresholdRule,
+)
 from apex_tpu.obs.metrics import (
     LATENCY_BUCKETS_S,
     Counter,
@@ -46,6 +61,7 @@ from apex_tpu.obs.metrics import (
     MetricsRegistry,
     REGISTRY,
     counter,
+    declare_scope,
     gauge,
     histogram,
     prometheus_text,
@@ -78,6 +94,10 @@ from apex_tpu.obs.trace import (
 )
 
 __all__ = [
+    "AbsenceRule",
+    "AlertEngine",
+    "BurnRateRule",
+    "Condition",
     "LATENCY_BUCKETS_S",
     "Counter",
     "Gauge",
@@ -87,13 +107,17 @@ __all__ = [
     "RequestRecord",
     "RequestTraceRecorder",
     "SLOReport",
+    "Selector",
     "Span",
+    "ThresholdRule",
     "TraceRecorder",
+    "alerts",
     "bridge",
     "build_report",
     "counter",
     "crosscheck_quantiles",
     "current_span",
+    "declare_scope",
     "gauge",
     "histogram",
     "install_recorder",

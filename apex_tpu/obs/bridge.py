@@ -36,7 +36,7 @@ from __future__ import annotations
 from apex_tpu import _logging
 from apex_tpu.obs import metrics, trace
 
-__all__ = ["install", "uninstall", "installed"]
+__all__ = ["install", "uninstall", "installed", "register_replica"]
 
 # -- the metric inventory (each name registered at exactly ONE call site;
 #    tools/check_metrics.py enforces naming + uniqueness + documentation
@@ -70,11 +70,13 @@ CHECKPOINTS_REJECTED = metrics.counter(
     "checkpoints skipped by the newest-valid fallback walk")
 SERVING_TTFT = metrics.histogram(
     "apex_serving_ttft_seconds",
-    "request submit -> first token (queue wait + prefill)")
+    "request submit -> first token (queue wait + prefill)",
+    scope_labels=("replica",))
 SERVING_QUEUE_WAIT = metrics.histogram(
     "apex_serving_queue_wait_seconds",
     "request submit -> slot admission (time spent waiting for "
-    "capacity; the queueing component of TTFT)")
+    "capacity; the queueing component of TTFT)",
+    scope_labels=("replica",))
 SERVING_GOODPUT = metrics.gauge(
     "apex_serving_goodput_ratio",
     "requests meeting their deadline / requests offered, for the most "
@@ -85,24 +87,31 @@ SERVING_PREFILL_DURATION = metrics.histogram(
     ("bucket",))
 SERVING_PER_TOKEN = metrics.histogram(
     "apex_serving_decode_per_token_seconds",
-    "steady-state decode latency per generated token")
+    "steady-state decode latency per generated token",
+    scope_labels=("replica",))
 SERVING_TOKENS_PER_S = metrics.gauge(
     "apex_serving_tokens_per_second",
-    "throughput of the most recently finished request")
+    "throughput of the most recently finished request",
+    scope_labels=("replica",))
 SERVING_QUEUE_DEPTH = metrics.gauge(
-    "apex_serving_queue_depth", "requests waiting for a decode slot")
+    "apex_serving_queue_depth", "requests waiting for a decode slot",
+    scope_labels=("replica",))
 SERVING_SLOT_OCCUPANCY = metrics.gauge(
-    "apex_serving_slot_occupancy", "active decode slots / total slots")
+    "apex_serving_slot_occupancy", "active decode slots / total slots",
+    scope_labels=("replica",))
 SERVING_CACHE_UTILIZATION = metrics.gauge(
     "apex_serving_cache_utilization",
-    "filled KV-cache positions / total capacity")
+    "filled KV-cache positions / total capacity",
+    scope_labels=("replica",))
 SERVING_DECODE_COMPILES = metrics.gauge(
     "apex_serving_decode_compiles",
-    "distinct compiles of the batched decode step (1 == shape-stable)")
+    "distinct compiles of the batched decode step (1 == shape-stable)",
+    scope_labels=("replica",))
 SERVING_PREFILL_BACKLOG = metrics.gauge(
     "apex_serving_prefill_backlog",
     "prompt tokens admitted or queued but not yet cached (deferred by "
-    "the per-step prefill budget)")
+    "the per-step prefill budget)",
+    scope_labels=("replica",))
 SERVING_PREFIX_HITS = metrics.counter(
     "apex_serving_prefix_hit_total",
     "admissions that restored a cached prompt prefix (prefill resumed "
@@ -119,7 +128,8 @@ SERVING_PREFIX_SAVED = metrics.histogram(
 SERVING_PREFIX_CACHED_TOKENS = metrics.gauge(
     "apex_serving_prefix_cached_tokens",
     "tokens of K/V held by the cross-request prefix cache (refreshed "
-    "per scheduler step while prefix caching is enabled)")
+    "per scheduler step while prefix caching is enabled)",
+    scope_labels=("replica",))
 SERVING_SPEC_DRAFTED = metrics.counter(
     "apex_serving_spec_drafted_total",
     "draft tokens proposed by prompt lookup (speculative decode)")
@@ -139,11 +149,13 @@ SERVING_SPEC_ACCEPT_LENGTH = metrics.histogram(
 SERVING_SPEC_SPEEDUP = metrics.gauge(
     "apex_serving_spec_speedup",
     "tokens emitted per verify dispatch on the speculative path "
-    "(1.0 == plain decode's one token per dispatch)")
+    "(1.0 == plain decode's one token per dispatch)",
+    scope_labels=("replica",))
 SERVING_BLOCK_POOL_UTILIZATION = metrics.gauge(
     "apex_serving_block_pool_utilization",
     "allocated KV pool blocks / allocatable blocks (paged cache; "
-    "refreshed per scheduler step while a paged engine serves)")
+    "refreshed per scheduler step while a paged engine serves)",
+    scope_labels=("replica",))
 SERVING_BLOCK_ALIAS_HITS = metrics.counter(
     "apex_serving_block_alias_hits_total",
     "prefix-cache blocks reused by block-table aliasing — zero-copy "
@@ -155,15 +167,18 @@ SERVING_BLOCK_COW = metrics.counter(
 SERVING_PREEMPTED = metrics.counter(
     "apex_serving_preempted_total",
     "DECODE streams losslessly preempted by a higher-priority "
-    "admission (each resumes bit-exactly later)")
+    "admission (each resumes bit-exactly later)",
+    scope_labels=("replica",))
 SERVING_CANCELLED = metrics.counter(
     "apex_serving_cancelled_total",
     "requests cancelled by the caller (slot/blocks/pins released; "
-    "partial output kept in the result)")
+    "partial output kept in the result)",
+    scope_labels=("replica",))
 SERVING_SHED = metrics.counter(
     "apex_serving_shed_total",
     "queued or suspended requests shed at an expired deadline before "
-    "spending further prefill budget (charged against goodput)")
+    "spending further prefill budget (charged against goodput)",
+    scope_labels=("replica",))
 SERVING_TP_SIZE = metrics.gauge(
     "apex_serving_tp_size",
     "tensor-parallel mesh width the decode engine's programs run over "
@@ -279,9 +294,44 @@ SERVING_QUANT_AGREEMENT = metrics.gauge(
     "greedy token-stream agreement of the quantized engine against its "
     "fp32 reference over the most recent evaluation window (1.0 == "
     "bit-identical token stream)")
+SERVING_ALERTS_FIRING = metrics.gauge(
+    "apex_serving_alerts_firing",
+    "1 while the named alert rule is in the FIRING state, 0 after it "
+    "resolves (set from serving_alert_firing/resolved events; rule "
+    "cardinality is the AlertEngine's declared rule list)", ("rule",))
+SERVING_ALERT_TRANSITIONS = metrics.counter(
+    "apex_serving_alert_transitions_total",
+    "alert lifecycle transitions (firing + resolved) across all rules "
+    "— a flapping rule shows up here long before a dashboard does")
 TIMER_SECONDS = metrics.gauge(
     "apex_timer_seconds",
     "pipeline Timers accumulated seconds by region", ("region",))
+
+# -- per-replica attribution ------------------------------------------------
+#
+# Named schedulers register here before stamping `replica` onto their
+# events; the set's size IS the scope's cardinality bound (fleet size),
+# widened monotonically so replacement replicas with fresh names still
+# fit.  Unnamed schedulers never call this and keep today's unlabeled
+# series byte-identical.
+
+_KNOWN_REPLICAS: set = set()
+
+
+def register_replica(name: str) -> None:
+    """Declare a replica name as a legal ``replica`` label value (widens
+    the scope's cardinality bound to the count of distinct names)."""
+    _KNOWN_REPLICAS.add(str(name))
+    metrics.REGISTRY.declare_scope("replica", len(_KNOWN_REPLICAS))
+
+
+def _replica(event: dict) -> dict:
+    """``{"replica": name}`` when the event is replica-attributed (a
+    named scheduler stamped it), else ``{}`` — splatting this into a
+    metric update dual-writes the attributed series beside the
+    fleet-aggregate one without branching at every call site."""
+    name = event.get("replica")
+    return {"replica": name} if isinstance(name, str) else {}
 
 
 def _on_retry_attempt(event: dict) -> None:
@@ -329,12 +379,18 @@ def _on_serving_first_token(event: dict) -> None:
     ttft_s = _measurement(event, "ttft_s")
     if ttft_s is not None:
         SERVING_TTFT.observe(ttft_s)
+        replica = _replica(event)
+        if replica:
+            SERVING_TTFT.observe(ttft_s, **replica)
 
 
 def _on_serving_request_admitted(event: dict) -> None:
     queue_wait_s = _measurement(event, "queue_wait_s")
     if queue_wait_s is not None:
         SERVING_QUEUE_WAIT.observe(queue_wait_s)
+        replica = _replica(event)
+        if replica:
+            SERVING_QUEUE_WAIT.observe(queue_wait_s, **replica)
 
 
 def _on_serving_prefill_chunk(event: dict) -> None:
@@ -385,23 +441,37 @@ def _on_serving_block_cow(event: dict) -> None:
 
 def _on_serving_request_preempted(event: dict) -> None:
     SERVING_PREEMPTED.inc()
+    replica = _replica(event)
+    if replica:
+        SERVING_PREEMPTED.inc(**replica)
 
 
 def _on_serving_request_cancelled(event: dict) -> None:
     SERVING_CANCELLED.inc()
+    replica = _replica(event)
+    if replica:
+        SERVING_CANCELLED.inc(**replica)
 
 
 def _on_serving_request_shed(event: dict) -> None:
     SERVING_SHED.inc()
+    replica = _replica(event)
+    if replica:
+        SERVING_SHED.inc(**replica)
 
 
 def _on_serving_request_finished(event: dict) -> None:
+    replica = _replica(event)
     per_token_ms = _measurement(event, "per_token_ms")
     if per_token_ms is not None:
         SERVING_PER_TOKEN.observe(per_token_ms / 1e3)
+        if replica:
+            SERVING_PER_TOKEN.observe(per_token_ms / 1e3, **replica)
     tokens_per_s = _measurement(event, "tokens_per_s")
     if tokens_per_s is not None:
         SERVING_TOKENS_PER_S.set(tokens_per_s)
+        if replica:
+            SERVING_TOKENS_PER_S.set(tokens_per_s, **replica)
 
 
 def _on_serving_tp_step(event: dict) -> None:
@@ -514,6 +584,16 @@ def _on_serving_rollout_promoted(event: dict) -> None:
         SERVING_ROLLOUT_WALL_SECONDS.observe(duration_s)
 
 
+def _on_serving_alert_firing(event: dict) -> None:
+    SERVING_ALERTS_FIRING.set(1, rule=str(event.get("rule", "unknown")))
+    SERVING_ALERT_TRANSITIONS.inc()
+
+
+def _on_serving_alert_resolved(event: dict) -> None:
+    SERVING_ALERTS_FIRING.set(0, rule=str(event.get("rule", "unknown")))
+    SERVING_ALERT_TRANSITIONS.inc()
+
+
 _HANDLERS = {
     "retry_attempt": _on_retry_attempt,
     "retry_exhausted": _on_retry_exhausted,
@@ -551,6 +631,8 @@ _HANDLERS = {
     "serving_rollout_rolled_back": _on_serving_rollout_rolled_back,
     "serving_rollout_promoted": _on_serving_rollout_promoted,
     "serving_quant_eval": _on_serving_quant_eval,
+    "serving_alert_firing": _on_serving_alert_firing,
+    "serving_alert_resolved": _on_serving_alert_resolved,
 }
 
 

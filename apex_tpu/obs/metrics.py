@@ -16,6 +16,14 @@ is the apex_tpu equivalent, deliberately dependency-free:
 - **Labeled series.**  Every instrument may declare ``labelnames``; one
   instrument then holds one series per distinct label-value tuple
   (``apex_events_total{event="retry_attempt"}``).
+- **Bounded scope labels.**  An instrument may additionally declare
+  ``scope_labels`` — labels that are *optional per update* (absent ⇒
+  the plain series, byte-identical to an instrument that never heard
+  of the scope; present ⇒ an attributed series such as
+  ``{replica="r0"}``).  A scope label may only take values while a
+  cardinality bound is declared (:meth:`MetricsRegistry.declare_scope`
+  — the fleet router declares its fleet size), so per-replica
+  attribution can never explode a process's series count.
 - **Exporters, not a server.**  :meth:`MetricsRegistry.prometheus_text`
   renders the Prometheus text exposition format (serve it from any
   HTTP handler, or dump it to a file for a node-exporter textfile
@@ -41,7 +49,8 @@ import re
 import threading
 import time
 from bisect import bisect_left
-from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
+from typing import (Callable, Dict, Iterable, Mapping, Optional, Sequence,
+                    Tuple)
 
 __all__ = [
     "LATENCY_BUCKETS_S",
@@ -51,6 +60,7 @@ __all__ = [
     "MetricsRegistry",
     "REGISTRY",
     "counter",
+    "declare_scope",
     "gauge",
     "histogram",
     "prometheus_text",
@@ -106,12 +116,20 @@ def _escape_help(value: str) -> str:
 
 
 class _Metric:
-    """Common machinery: name validation, labeled series, one lock."""
+    """Common machinery: name validation, labeled series, one lock.
+
+    Series keys are canonical sorted ``(labelname, labelvalue)`` pair
+    tuples, so one instrument can hold both its plain series (scope
+    labels absent — exactly the pre-scope byte layout) and attributed
+    ``{replica=...}`` series side by side.
+    """
 
     kind = "untyped"
+    _registry: Optional["MetricsRegistry"] = None
 
     def __init__(self, name: str, help: str = "",
-                 labelnames: Sequence[str] = ()):
+                 labelnames: Sequence[str] = (),
+                 scope_labels: Sequence[str] = ()):
         if not _NAME_RE.match(name):
             raise ValueError(
                 f"metric name {name!r} must match {_NAME_RE.pattern} "
@@ -119,25 +137,85 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = _check_labels(labelnames)
-        self._lock = threading.Lock()
-        self._series: Dict[Tuple[str, ...], object] = {}
-
-    def _key(self, labels: Mapping[str, object]) -> Tuple[str, ...]:
-        if tuple(sorted(labels)) != tuple(sorted(self.labelnames)):
+        self.scope_labels = _check_labels(scope_labels)
+        overlap = set(self.labelnames) & set(self.scope_labels)
+        if overlap:
             raise ValueError(
-                f"{self.name}: got labels {sorted(labels)}, declared "
-                f"labelnames {sorted(self.labelnames)}")
-        return tuple(str(labels[k]) for k in self.labelnames)
+                f"{name}: {sorted(overlap)} declared as both labelnames "
+                f"and scope_labels — a label is required or optional, "
+                f"never both")
+        self._lock = threading.Lock()
+        self._series: Dict[Tuple, object] = {}
+
+    def _key(self, labels: Mapping[str, object]) -> Tuple:
+        base = self.labelnames
+        if not self.scope_labels:
+            if tuple(sorted(labels)) != tuple(sorted(base)):
+                raise ValueError(
+                    f"{self.name}: got labels {sorted(labels)}, declared "
+                    f"labelnames {sorted(base)}")
+        else:
+            extras = [k for k in labels if k not in base]
+            if (sorted(k for k in labels if k in base) != sorted(base)
+                    or any(k not in self.scope_labels for k in extras)):
+                raise ValueError(
+                    f"{self.name}: got labels {sorted(labels)}, declared "
+                    f"labelnames {sorted(base)} (+ optional scope labels "
+                    f"{sorted(self.scope_labels)})")
+            if extras:
+                key = tuple(sorted((k, str(v))
+                                   for k, v in labels.items()))
+                # bound enforcement is an O(series) scan — only a key
+                # the metric has never seen can add a new scope value,
+                # so the established hot path skips it entirely (racy
+                # membership read is benign: both racers just enforce)
+                if key not in self._series:
+                    self._enforce_scope_bound(labels, extras)
+                return key
+        return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def _enforce_scope_bound(self, labels: Mapping[str, object],
+                             extras: Sequence[str]) -> None:
+        """A scope label may only grow a new series value while its
+        declared cardinality bound allows it — the mechanism exists so
+        per-replica attribution stays bounded by fleet size, never
+        open-ended like a rid or a user string."""
+        reg = self._registry
+        for k in extras:
+            bound = reg.scope_bound(k) if reg is not None else None
+            if bound is None:
+                raise ValueError(
+                    f"{self.name}: scope label {k!r} has no declared "
+                    f"cardinality bound — declare_scope({k!r}, n) first "
+                    f"(the fleet router and named schedulers do this at "
+                    f"construction)")
+            value = str(labels[k])
+            with self._lock:
+                seen = {dict(key).get(k) for key in self._series}
+            seen.discard(None)
+            if value not in seen and len(seen) >= bound:
+                raise ValueError(
+                    f"{self.name}: scope label {k!r}={value!r} would "
+                    f"exceed its declared cardinality bound {bound} "
+                    f"(values already present: {sorted(seen)})")
+
+    def _label_order(self, key: Tuple) -> list:
+        """Render order for one series key: declared labelnames first,
+        then any scope labels present — so pre-scope output is
+        byte-identical and attributed series read naturally."""
+        present = dict(key)
+        return [n for n in (*self.labelnames, *self.scope_labels)
+                if n in present]
 
     def _signature(self):
-        return (type(self), self.labelnames)
+        return (type(self), self.labelnames, self.scope_labels)
 
     def series_count(self) -> int:
         with self._lock:
             return len(self._series)
 
     def _collect(self):
-        """``[(label_values, value), ...]`` point-in-time copy, sorted
+        """``[(label_pairs, value), ...]`` point-in-time copy, sorted
         for deterministic export."""
         with self._lock:
             return sorted(self._series.items())
@@ -183,10 +261,10 @@ class Gauge(_Metric):
     kind = "gauge"
 
     def __init__(self, name: str, help: str = "",
-                 labelnames: Sequence[str] = ()):
-        super().__init__(name, help, labelnames)
-        self._functions: Dict[Tuple[str, ...],
-                              Callable[[], float]] = {}
+                 labelnames: Sequence[str] = (),
+                 scope_labels: Sequence[str] = ()):
+        super().__init__(name, help, labelnames, scope_labels)
+        self._functions: Dict[Tuple, Callable[[], float]] = {}
 
     def set(self, value: float, **labels) -> None:
         key = self._key(labels)
@@ -262,9 +340,10 @@ class Histogram(_Metric):
 
     def __init__(self, name: str, help: str = "",
                  labelnames: Sequence[str] = (),
-                 buckets: Sequence[float] = LATENCY_BUCKETS_S):
-        super().__init__(name, help, labelnames)
-        if "le" in self.labelnames:
+                 buckets: Sequence[float] = LATENCY_BUCKETS_S,
+                 scope_labels: Sequence[str] = ()):
+        super().__init__(name, help, labelnames, scope_labels)
+        if "le" in self.labelnames or "le" in self.scope_labels:
             # the exposition adds its own le= per bucket; a user 'le'
             # label would emit duplicate labels and fail the scrape
             raise ValueError(
@@ -278,7 +357,8 @@ class Histogram(_Metric):
         self.buckets = edges
 
     def _signature(self):
-        return (type(self), self.labelnames, self.buckets)
+        return (type(self), self.labelnames, self.scope_labels,
+                self.buckets)
 
     def observe(self, value: float, **labels) -> None:
         value = float(value)
@@ -405,6 +485,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.RLock()
         self._metrics: Dict[str, _Metric] = {}
+        self._scope_bounds: Dict[str, int] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -422,23 +503,53 @@ class MetricsRegistry:
                         f"{cls.__name__}{candidate.labelnames}")
                 return got
             metric = cls(name, help, labelnames, **kw)
+            metric._registry = self
             self._metrics[name] = metric
             return metric
 
     def counter(self, name: str, help: str = "",
-                labelnames: Sequence[str] = ()) -> Counter:
-        return self._register(Counter, name, help, labelnames)
+                labelnames: Sequence[str] = (),
+                scope_labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labelnames,
+                              scope_labels=scope_labels)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: Sequence[str] = ()) -> Gauge:
-        return self._register(Gauge, name, help, labelnames)
+              labelnames: Sequence[str] = (),
+              scope_labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames,
+                              scope_labels=scope_labels)
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Sequence[float] = LATENCY_BUCKETS_S
-                  ) -> Histogram:
+                  buckets: Sequence[float] = LATENCY_BUCKETS_S,
+                  scope_labels: Sequence[str] = ()) -> Histogram:
         return self._register(Histogram, name, help, labelnames,
-                              buckets=buckets)
+                              buckets=buckets, scope_labels=scope_labels)
+
+    def declare_scope(self, label: str, bound: int) -> int:
+        """Declare (or widen) the cardinality bound for a scope label.
+
+        Bounds only ever widen — ``max(existing, bound)`` — so two
+        independent declarers (a fleet router sizing ``replica`` to its
+        fleet, a named standalone scheduler declaring 1) compose instead
+        of fighting.  Returns the effective bound."""
+        if not _LABEL_RE.match(label):
+            raise ValueError(f"invalid scope label {label!r} "
+                             f"(must match {_LABEL_RE.pattern})")
+        bound = int(bound)
+        if bound < 1:
+            raise ValueError(
+                f"scope label {label!r}: bound must be >= 1, got {bound}")
+        with self._lock:
+            bound = max(self._scope_bounds.get(label, 0), bound)
+            self._scope_bounds[label] = bound
+            return bound
+
+    def scope_bound(self, label: str) -> Optional[int]:
+        """The declared cardinality bound for ``label`` (None when the
+        label has never been declared)."""
+        with self._lock:
+            return self._scope_bounds.get(label)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
@@ -458,15 +569,22 @@ class MetricsRegistry:
 
     # -- export ------------------------------------------------------------
 
-    def snapshot(self) -> Dict[str, dict]:
+    def snapshot(self, names: Optional[Iterable[str]] = None
+                 ) -> Dict[str, dict]:
         """Point-in-time ``{name: {type, help, labelnames, series}}``.
 
         Series are ``[{labels: {...}, ...value fields...}]``; histograms
         carry ``buckets`` (edges), cumulative ``bucket_counts``, ``sum``
         and ``count`` per series.  This is the read tests assert against.
+        ``names=`` restricts the walk to the listed metrics (unknown
+        names are simply absent) — per-step readers like the alert
+        engine pay for the series they evaluate, not the whole registry.
         """
         with self._lock:
             metrics = sorted(self._metrics.items())
+        if names is not None:
+            want = set(names)
+            metrics = [(n, m) for n, m in metrics if n in want]
         out: Dict[str, dict] = {}
         for name, m in metrics:
             entry = {"type": m.kind, "help": m.help,
@@ -474,7 +592,8 @@ class MetricsRegistry:
             if isinstance(m, Histogram):
                 entry["buckets"] = list(m.buckets)
             for key, value in m._collect():
-                labels = dict(zip(m.labelnames, key))
+                kv = dict(key)
+                labels = {n: kv[n] for n in m._label_order(key)}
                 if isinstance(m, Histogram):
                     cum, running = [], 0
                     for c in value["counts"]:
@@ -500,9 +619,10 @@ class MetricsRegistry:
                 lines.append(f"# HELP {name} {_escape_help(m.help)}")
             lines.append(f"# TYPE {name} {m.kind}")
             for key, value in m._collect():
+                kv = dict(key)
                 pairs = ",".join(
-                    f'{ln}="{_escape(lv)}"'
-                    for ln, lv in zip(m.labelnames, key))
+                    f'{ln}="{_escape(kv[ln])}"'
+                    for ln in m._label_order(key))
                 if isinstance(m, Histogram):
                     running = 0
                     for edge, c in zip(m.buckets, value["counts"]):
@@ -545,21 +665,30 @@ REGISTRY = MetricsRegistry()
 
 
 def counter(name: str, help: str = "",
-            labelnames: Sequence[str] = ()) -> Counter:
+            labelnames: Sequence[str] = (),
+            scope_labels: Sequence[str] = ()) -> Counter:
     """Get-or-create a :class:`Counter` in the default registry."""
-    return REGISTRY.counter(name, help, labelnames)
+    return REGISTRY.counter(name, help, labelnames, scope_labels)
 
 
 def gauge(name: str, help: str = "",
-          labelnames: Sequence[str] = ()) -> Gauge:
+          labelnames: Sequence[str] = (),
+          scope_labels: Sequence[str] = ()) -> Gauge:
     """Get-or-create a :class:`Gauge` in the default registry."""
-    return REGISTRY.gauge(name, help, labelnames)
+    return REGISTRY.gauge(name, help, labelnames, scope_labels)
 
 
 def histogram(name: str, help: str = "", labelnames: Sequence[str] = (),
-              buckets: Sequence[float] = LATENCY_BUCKETS_S) -> Histogram:
+              buckets: Sequence[float] = LATENCY_BUCKETS_S,
+              scope_labels: Sequence[str] = ()) -> Histogram:
     """Get-or-create a :class:`Histogram` in the default registry."""
-    return REGISTRY.histogram(name, help, labelnames, buckets)
+    return REGISTRY.histogram(name, help, labelnames, buckets,
+                              scope_labels)
+
+
+def declare_scope(label: str, bound: int) -> int:
+    """Default-registry :meth:`MetricsRegistry.declare_scope`."""
+    return REGISTRY.declare_scope(label, bound)
 
 
 def snapshot() -> Dict[str, dict]:

@@ -98,6 +98,12 @@ class RequestRecord:
     # control-plane annotations (empty/zero without a policy)
     preemptions: int = 0               # lossless suspend/resume cycles
     preempts: List[dict] = dataclasses.field(default_factory=list)
+    # fleet annotations (empty/None off a fleet router): the hop trail
+    # — placed / failover / resumed / shed entries with the replica
+    # names and recorder-clock stamps — and the replica the request
+    # last landed on (its placement, updated by a mid-stream resume)
+    hops: List[dict] = dataclasses.field(default_factory=list)
+    replica: Optional[str] = None
     # the scheduler's own clock measurements (cross-check material)
     scheduler_ttft_s: Optional[float] = None
     scheduler_queue_wait_s: Optional[float] = None
@@ -173,6 +179,8 @@ class RequestRecord:
             "prefix": self.prefix, "alias": self.alias,
             "preemptions": self.preemptions,
             "preempts": list(self.preempts),
+            "hops": list(self.hops),
+            "replica": self.replica,
             "scheduler_ttft_s": self.scheduler_ttft_s,
             "scheduler_queue_wait_s": self.scheduler_queue_wait_s,
             "per_token_ms": self.per_token_ms,
@@ -201,18 +209,32 @@ class RequestTraceRecorder:
     never truncated mid-flight), keeping the run's beginning.
     """
 
+    #: fleet lanes sit far above the per-request tracks: requests use
+    #: tid 0..N (assembly order), replicas use tid >= 1 << 20 (sorted
+    #: by name), and the fleet control lane sits just below them
+    REPLICA_TID_BASE = 1 << 20
+    FLEET_TID = REPLICA_TID_BASE - 1
+
     def __init__(self, clock: Callable[[], float] = time.monotonic,
-                 max_requests: int = 100_000):
+                 max_requests: int = 100_000,
+                 max_fleet_events: int = 10_000):
         if max_requests < 1:
             raise ValueError(
                 f"max_requests must be >= 1, got {max_requests}")
         self._clock = clock
         self.max_requests = int(max_requests)
+        self.max_fleet_events = int(max_fleet_events)
         self.dropped = 0
+        self.fleet_dropped = 0
         self._lock = threading.Lock()
         self._open: Dict[str, RequestRecord] = {}
         self._done: List[RequestRecord] = []
         self._track: Dict[str, int] = {}       # rid -> stable track index
+        # rid-less fleet/rollout control events (health transitions,
+        # rollout waves, weight swaps) — the timeline bands that give
+        # the per-request hop trails their context.  Bounded like the
+        # request map; overflow counts in fleet_dropped.
+        self._fleet_events: List[dict] = []
         self._warned_full = False
 
     # ---- sink lifecycle --------------------------------------------------
@@ -263,9 +285,35 @@ class RequestTraceRecorder:
         value = event.get(field)
         return float(value) if isinstance(value, (int, float)) else None
 
+    # rid-less fleet/rollout control events worth a timeline band (the
+    # per-request fleet events — routed/failover/resumed/shed — fold
+    # into hop trails instead)
+    _FLEET_BAND_KINDS = frozenset((
+        "serving_fleet_replica_state",
+        "serving_rollout_started",
+        "serving_rollout_replica_upgraded",
+        "serving_rollout_canary_verdict",
+        "serving_rollout_promoted",
+        "serving_rollout_halted",
+        "serving_rollout_rolled_back",
+        "serving_weights_swapped",
+    ))
+
     def _sink(self, event: dict) -> None:
         kind = event.get("event")
         if not isinstance(kind, str) or not kind.startswith("serving_"):
+            return
+        if kind in self._FLEET_BAND_KINDS:
+            now = self._clock()
+            with self._lock:
+                if len(self._fleet_events) >= self.max_fleet_events:
+                    self.fleet_dropped += 1
+                    return
+                entry = {k: v for k, v in event.items()
+                         if k not in ("event", "time")}
+                entry["kind"] = kind
+                entry["t"] = now
+                self._fleet_events.append(entry)
             return
         rid = event.get("rid")
         if not isinstance(rid, str):
@@ -276,7 +324,11 @@ class RequestTraceRecorder:
                 st = self._get(rid, create=True, count_drop=True)
                 if st is None:
                     return
-                st.t_queued = now
+                if st.t_queued is None:
+                    # a failover REQUEUE re-emits queued on the
+                    # survivor; queue_wait must span from the
+                    # original submit, not restart at the requeue
+                    st.t_queued = now
                 pt = self._num(event, "prompt_tokens")
                 st.prompt_tokens = int(pt) if pt is not None else None
             elif kind == "serving_request_admitted":
@@ -344,6 +396,55 @@ class RequestTraceRecorder:
                 if st is not None and st.preempts and (
                         st.preempts[-1].get("t_resumed") is None):
                     st.preempts[-1]["t_resumed"] = now
+            elif kind == "serving_fleet_routed":
+                # create=True: the router may route a request the
+                # recorder missed queueing (installed mid-flight)
+                st = self._get(rid, create=True)
+                if st is None:
+                    return
+                replica = event.get("replica")
+                st.hops.append({
+                    "kind": "placed", "replica": replica,
+                    "retries": self._num(event, "retries"),
+                    "weights_step": self._num(event, "weights_step"),
+                    "t": now})
+                if isinstance(replica, str):
+                    st.replica = replica
+            elif kind == "serving_fleet_failover":
+                st = self._get(rid, create=False)
+                if st is not None:
+                    # event's replica is the DONOR the stream left
+                    st.hops.append({
+                        "kind": "failover",
+                        "replica": event.get("replica"),
+                        "mode": event.get("mode"),
+                        "new_tokens": self._num(event, "new_tokens"),
+                        "t": now})
+            elif kind == "serving_fleet_resumed":
+                st = self._get(rid, create=False)
+                if st is not None:
+                    replica = event.get("replica")
+                    st.hops.append({
+                        "kind": "resumed", "replica": replica,
+                        "from_replica": event.get("from_replica"),
+                        "mode": event.get("mode"),
+                        "duration_s": self._num(event, "duration_s"),
+                        "t": now})
+                    if isinstance(replica, str):
+                        st.replica = replica
+            elif kind == "serving_fleet_shed":
+                # a router-level terminal: the stream never lands again
+                # (shed at submit, at failover with failover off, or
+                # when no surviving capacity could absorb the victim)
+                st = self._open.pop(rid, None)
+                if st is None:
+                    return
+                st.hops.append({
+                    "kind": "shed", "reason": event.get("reason"),
+                    "t": now})
+                st.t_finished = now
+                st.finish_reason = "fleet_shed"
+                self._done.append(st)
             elif kind in ("serving_request_cancelled",
                           "serving_request_shed"):
                 # a non-served terminal: close the record (it will be
@@ -386,6 +487,12 @@ class RequestTraceRecorder:
         with self._lock:
             return list(self._open.values())
 
+    def fleet_events(self) -> List[dict]:
+        """Captured rid-less fleet/rollout control events (health
+        transitions, rollout waves, weight swaps) in arrival order."""
+        with self._lock:
+            return [dict(e) for e in self._fleet_events]
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._done)
@@ -406,7 +513,9 @@ class RequestTraceRecorder:
             done = list(self._done)
             open_count = len(self._open)
             dropped = self.dropped
+            fleet_dropped = self.fleet_dropped
             track = dict(self._track)
+            fleet = [dict(e) for e in self._fleet_events]
         events: List[dict] = []
 
         def _us(t: float) -> float:
@@ -470,17 +579,133 @@ class RequestTraceRecorder:
                 if dur is None or end is None:
                     continue
                 slice_("spec_verify", tid, end - dur, end)
+        self._fleet_lanes(events, done, fleet, pid, slice_)
         events.sort(key=lambda e: (e.get("ts", -1.0), e["tid"]))
         payload = {"traceEvents": events, "displayTimeUnit": "ms"}
         other = {}
         if dropped:
             other["dropped_requests"] = dropped
             other["max_requests"] = self.max_requests
+        if fleet_dropped:
+            other["dropped_fleet_events"] = fleet_dropped
+            other["max_fleet_events"] = self.max_fleet_events
         if open_count:
             other["open_requests"] = open_count
         if other:
             payload["otherData"] = other
         return payload
+
+    def _fleet_lanes(self, events: List[dict], done: List[RequestRecord],
+                     fleet: List[dict], pid: int, slice_) -> None:
+        """One lane per replica (stream residency from the hop trails +
+        health-state bands + reload-swap slices) plus one fleet control
+        lane (rollout waves, weight swaps).  A run that never touched a
+        fleet adds NOTHING here — the single-engine export stays
+        byte-identical."""
+        replicas = set()
+        for st in done:
+            for hop in st.hops:
+                for field in ("replica", "from_replica"):
+                    name = hop.get(field)
+                    if isinstance(name, str):
+                        replicas.add(name)
+        for ev in fleet:
+            name = ev.get("replica")
+            if isinstance(name, str):
+                replicas.add(name)
+        if not replicas and not fleet:
+            return
+        lane = {name: self.REPLICA_TID_BASE + i
+                for i, name in enumerate(sorted(replicas))}
+
+        def instant(name, tid, t, **args):
+            if t is None:
+                return
+            ev = {"name": name, "ph": "i", "cat": "apex_fleet",
+                  "ts": round(t * 1e6, 3), "pid": pid, "tid": tid,
+                  "s": "t"}
+            if args:
+                ev["args"] = {k: v for k, v in args.items()
+                              if v is not None}
+            events.append(ev)
+
+        for name, tid in lane.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid,
+                           "args": {"name": f"replica {name}"}})
+            events.append({"name": "thread_sort_index", "ph": "M",
+                           "pid": pid, "tid": tid,
+                           "args": {"sort_index": tid}})
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": self.FLEET_TID, "args": {"name": "fleet"}})
+        events.append({"name": "thread_sort_index", "ph": "M",
+                       "pid": pid, "tid": self.FLEET_TID,
+                       "args": {"sort_index": self.FLEET_TID}})
+
+        # residency: walk each hop trail; placed/resumed opens a span
+        # on that replica's lane, failover closes the donor span (the
+        # migration reads as the rid ending on one lane and reappearing
+        # on another), the terminal stamp closes whatever is open
+        for st in done:
+            open_span = None            # (replica, t_start, how)
+            for hop in st.hops:
+                k = hop.get("kind")
+                if k in ("placed", "resumed"):
+                    if open_span is not None:
+                        slice_(st.rid, lane.get(open_span[0],
+                                                self.FLEET_TID),
+                               open_span[1], hop.get("t"),
+                               rid=st.rid, via=open_span[2])
+                    name = hop.get("replica")
+                    if isinstance(name, str):
+                        open_span = (name, hop.get("t"), k)
+                elif k in ("failover", "shed"):
+                    if open_span is not None:
+                        slice_(st.rid, lane.get(open_span[0],
+                                                self.FLEET_TID),
+                               open_span[1], hop.get("t"),
+                               rid=st.rid, via=open_span[2],
+                               ended_by=k, mode=hop.get("mode"))
+                        open_span = None
+            if open_span is not None:
+                slice_(st.rid, lane.get(open_span[0], self.FLEET_TID),
+                       open_span[1], st.t_finished,
+                       rid=st.rid, via=open_span[2],
+                       finish_reason=st.finish_reason)
+
+        # control bands: health transitions on the replica's own lane,
+        # rollout/reload milestones on the fleet lane; a reload swap
+        # pause renders as a slice ending at the upgrade event
+        for ev in fleet:
+            kind = ev.get("kind")
+            t = ev.get("t")
+            name = ev.get("replica")
+            tid = lane.get(name, self.FLEET_TID)
+            if kind == "serving_fleet_replica_state":
+                instant(f"health:{ev.get('state')}", tid, t,
+                        replica=name, from_state=ev.get("from_state"))
+            elif kind == "serving_rollout_replica_upgraded":
+                swap_s = self._num(ev, "swap_s")
+                if swap_s is not None and t is not None:
+                    slice_("reload_swap", tid, t - swap_s, t,
+                           replica=name, step=ev.get("step"))
+                else:
+                    instant("reload_swap", tid, t, replica=name)
+            elif kind == "serving_weights_swapped":
+                swap_s = self._num(ev, "swap_s")
+                if swap_s is not None and t is not None:
+                    slice_("weights_swap", tid, t - swap_s, t,
+                           step=ev.get("step"))
+                else:
+                    instant("weights_swap", tid, t, step=ev.get("step"))
+            else:
+                # rollout lifecycle milestones (started / canary
+                # verdict / promoted / halted / rolled back)
+                label = kind.replace("serving_", "", 1)
+                instant(label, self.FLEET_TID, t,
+                        verdict=ev.get("verdict"),
+                        step=ev.get("step"),
+                        replicas=ev.get("replicas"))
 
     def export(self, path: str) -> dict:
         """Atomically write the Perfetto-loadable trace JSON (same

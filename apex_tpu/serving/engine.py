@@ -1365,9 +1365,14 @@ class DecodeEngine:
                 self.params, self._cache,
                 np.asarray(tokens, np.int32), act)
             jax.block_until_ready(logits)
+            # a fleet scheduler stamps its replica name onto the engine
+            # (anonymous engines splat nothing — byte-identical stream)
+            replica = getattr(self, "name", None)
             emit_event("serving_tp_step", tp=self.tp_size,
                        active=int(act.sum()),
-                       duration_s=time.perf_counter() - t0)
+                       duration_s=time.perf_counter() - t0,
+                       **({"replica": replica}
+                          if isinstance(replica, str) else {}))
         self._lengths_host[act] += 1
         return logits
 

@@ -80,6 +80,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional
 
 from apex_tpu._logging import emit_event, get_logger
 from apex_tpu.obs import bridge as obs_bridge
+from apex_tpu.obs import metrics as obs_metrics
 from apex_tpu.serving.policy import SchedulingPolicy, WeightedRoundRobin
 from apex_tpu.serving.reload import assign_arm
 from apex_tpu.serving.scheduler import (
@@ -164,12 +165,14 @@ class FleetRouter:
     """
 
     def __init__(self, replicas: Mapping[str, object], *,
-                 config: FleetConfig = FleetConfig()):
+                 config: FleetConfig = FleetConfig(),
+                 alerts: Optional[object] = None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         names = list(replicas)
         clock = replicas[names[0]].clock
         engines = set()
+        sched_names = set()
         for name in names:
             sched = replicas[name]
             if sched.clock is not clock:
@@ -185,6 +188,21 @@ class FleetRouter:
                     f"replica — a fleet is N independent engines (two "
                     f"schedulers over one engine fight for slots)")
             engines.add(eid)
+            # named schedulers stamp their name onto every metric as
+            # the 'replica' label; two replicas sharing one scheduler
+            # name would silently merge into one metric identity
+            sname = getattr(sched, "name", None)
+            if sname is not None:
+                if sname in sched_names:
+                    raise ValueError(
+                        f"replica {name!r}: scheduler name {sname!r} is "
+                        f"already used by another replica — per-replica "
+                        f"metric attribution needs unique names")
+                sched_names.add(sname)
+        # the fleet size IS the replica label's cardinality bound
+        # (widen-only, so replacement replicas with fresh names fit)
+        obs_metrics.REGISTRY.declare_scope("replica", len(names))
+        self._alerts = alerts
         self.config = config
         self._clock: Callable[[], float] = clock
         now = clock()
@@ -719,6 +737,12 @@ class FleetRouter:
         self._steps += 1
         obs_bridge.SERVING_FLEET_REPLICAS_HEALTHY.set(
             self.replicas_healthy)
+        if self._alerts is not None:
+            # the fleet step boundary is the alert engine's evaluation
+            # tick: every gauge/counter above is freshly set, and the
+            # shared clock makes the firing/resolved ledger a
+            # deterministic function of the workload
+            self._alerts.evaluate(now=self._clock())
         return finished
 
     def run(self, max_steps: Optional[int] = None

@@ -55,6 +55,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 from apex_tpu._logging import emit_event, get_logger
+from apex_tpu.obs.alerts import Condition
 from apex_tpu.serving.fleet import ReplicaState
 
 logger = get_logger("serving.rollout")
@@ -112,7 +113,14 @@ class CanaryGate:
     def verdict(self, canary, baseline) -> Tuple[bool, List[str]]:
         """Compare two :class:`~apex_tpu.obs.slo.SLOReport` arms;
         returns ``(passed, reasons)`` with one reason per breached
-        threshold (empty on pass)."""
+        threshold (empty on pass).
+
+        Every regression check evaluates through
+        :class:`~apex_tpu.obs.alerts.Condition` — the same comparison
+        atom the fleet's :class:`~apex_tpu.obs.alerts.AlertEngine`
+        rules run on, so gating and alerting share one evaluation core
+        (the arithmetic is unchanged: each check builds the identical
+        float bound the inline comparisons used)."""
         reasons: List[str] = []
         if canary.completed < self.min_samples:
             reasons.append(
@@ -125,19 +133,21 @@ class CanaryGate:
                 c = self._p95(getattr(canary, series))
                 b = self._p95(getattr(baseline, series))
                 if c is not None and b is not None and b > 0 \
-                        and c > b * limit:
+                        and Condition(">", b * limit).holds(c):
                     reasons.append(
                         f"{series} p95 {c:.4f}s > {limit:g}x baseline "
                         f"{b:.4f}s")
             c_rate = canary.completed / max(canary.offered, 1)
             b_rate = baseline.completed / max(baseline.offered, 1)
-            if c_rate < b_rate - self.completion_margin:
+            if Condition("<", b_rate - self.completion_margin).holds(
+                    c_rate):
                 reasons.append(
                     f"completion rate {c_rate:.3f} trails baseline "
                     f"{b_rate:.3f} by more than {self.completion_margin}")
             if canary.goodput is not None and baseline.goodput is not None \
-                    and canary.goodput < baseline.goodput \
-                    - self.goodput_margin:
+                    and Condition(
+                        "<", baseline.goodput - self.goodput_margin
+                    ).holds(canary.goodput):
                 reasons.append(
                     f"goodput {canary.goodput:.3f} trails baseline "
                     f"{baseline.goodput:.3f} by more than "

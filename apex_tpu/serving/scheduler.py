@@ -304,7 +304,12 @@ class ContinuousBatchingScheduler:
                  speculation: Optional[SpeculationConfig] = None,
                  prefix_caching: Optional[PrefixCacheConfig] = None,
                  policy: Optional[SchedulingPolicy] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 name: Optional[str] = None):
+        if name is not None and (not isinstance(name, str) or not name):
+            raise ValueError(
+                f"scheduler name must be a non-empty string (it becomes "
+                f"the bounded 'replica' metric label), got {name!r}")
         if prefill_budget is None:
             prefill_budget = engine.prefill_len
         if prefill_budget < 1:
@@ -318,6 +323,16 @@ class ContinuousBatchingScheduler:
                 f"{engine.max_draft}) — widen draft_buckets or narrow "
                 f"the config")
         self.engine = engine
+        # replica identity: None == anonymous (today's unlabeled event
+        # stream and metric snapshot, byte-identical).  The engine gets
+        # the name too — its serving_tp_step emits attribute to this
+        # scheduler — and ALWAYS gets it assigned (None clears a stale
+        # name when an engine is reused across scheduler lifetimes, so
+        # a later anonymous run stays identity-clean).
+        self.name = name
+        engine.name = name
+        if name is not None:
+            obs_bridge.register_replica(name)
         self.max_queue = int(max_queue)
         self.log_interval = max(1, int(log_interval))
         self.prefill_budget = int(prefill_budget)
@@ -408,6 +423,14 @@ class ContinuousBatchingScheduler:
         # event so a mixed-version fleet mid-rollout is observable.
         self.weights_step: Optional[int] = None
 
+    def _emit(self, kind: str, **fields) -> None:
+        """Every serving event this scheduler emits, replica-stamped
+        when named.  Anonymous schedulers forward untouched — the
+        event stream stays byte-identical to the pre-fleet one."""
+        if self.name is not None:
+            fields["replica"] = self.name
+        emit_event(kind, **fields)
+
     # ---- submission ------------------------------------------------------
     def submit(self, request: Request) -> None:
         """Enqueue; raises :class:`QueueFull` at ``max_queue`` and
@@ -469,7 +492,7 @@ class ContinuousBatchingScheduler:
         self._live_rids.add(rid)
         if self.policy is not None:
             self._tenants_seen.add(request.tenant)
-        emit_event("serving_request_queued", rid=request.rid,
+        self._emit("serving_request_queued", rid=request.rid,
                    prompt_tokens=n, queue_depth=len(self._queue))
 
     # ---- introspection ---------------------------------------------------
@@ -591,7 +614,7 @@ class ContinuousBatchingScheduler:
         # the apex_serving_queue_wait_seconds histogram and the
         # request-trace recorder can cross-check its own stamps —
         # measured on this scheduler's (injectable) clock
-        emit_event("serving_request_admitted", rid=request.rid,
+        self._emit("serving_request_admitted", rid=request.rid,
                    slot=slot, prompt_tokens=len(request.prompt),
                    queue_depth=len(self._queue),
                    queue_wait_s=round(self._clock() - t_submit, 6))
@@ -686,7 +709,7 @@ class ContinuousBatchingScheduler:
         st.preemptions += 1
         self._suspended.append(sus)
         self._preempted_total += 1
-        emit_event("serving_request_preempted", rid=st.request.rid,
+        self._emit("serving_request_preempted", rid=st.request.rid,
                    slot=slot, priority=st.request.priority,
                    by_priority=by_priority,
                    new_tokens=len(st.tokens), cached_tokens=length)
@@ -710,7 +733,7 @@ class ContinuousBatchingScheduler:
         st.slot = slot
         self._active[slot] = st
         self._resumed_total += 1
-        emit_event("serving_request_resumed", rid=st.request.rid,
+        self._emit("serving_request_resumed", rid=st.request.rid,
                    slot=slot, cached_tokens=sus.length,
                    suspended_s=round(self._clock() - sus.t_suspended,
                                      6))
@@ -824,7 +847,7 @@ class ContinuousBatchingScheduler:
                         reason="shed")
                     self._shed_total += 1
                     shed.append(request.rid)
-                    emit_event("serving_request_shed", rid=request.rid,
+                    self._emit("serving_request_shed", rid=request.rid,
                                deadline_s=request.deadline_s,
                                waited_s=round(now - t_submit, 6),
                                new_tokens=0,
@@ -846,7 +869,7 @@ class ContinuousBatchingScheduler:
                         preemptions=st.preemptions)
                     self._shed_total += 1
                     shed.append(st.request.rid)
-                    emit_event("serving_request_shed",
+                    self._emit("serving_request_shed",
                                rid=st.request.rid, deadline_s=deadline,
                                waited_s=round(now - st.t_submit, 6),
                                new_tokens=len(st.tokens),
@@ -900,7 +923,7 @@ class ContinuousBatchingScheduler:
                 self._terminal_result(request, t_submit, t_first=0.0,
                                       tokens=[], reason="cancelled")
                 self._cancelled_total += 1
-                emit_event("serving_request_cancelled", rid=rid,
+                self._emit("serving_request_cancelled", rid=rid,
                            phase="queued", new_tokens=0)
                 return True
         for i, sus in enumerate(self._suspended):
@@ -914,7 +937,7 @@ class ContinuousBatchingScheduler:
                                       reason="cancelled",
                                       preemptions=st.preemptions)
                 self._cancelled_total += 1
-                emit_event("serving_request_cancelled", rid=rid,
+                self._emit("serving_request_cancelled", rid=rid,
                            phase="suspended",
                            new_tokens=len(st.tokens))
                 return True
@@ -934,7 +957,7 @@ class ContinuousBatchingScheduler:
                                       reason="cancelled",
                                       preemptions=st.preemptions)
                 self._cancelled_total += 1
-                emit_event("serving_request_cancelled", rid=rid,
+                self._emit("serving_request_cancelled", rid=rid,
                            phase=("decode" if st.tokens else "prefill"),
                            new_tokens=len(st.tokens))
                 return True
@@ -1064,7 +1087,7 @@ class ContinuousBatchingScheduler:
             self._live_rids.add(request.rid)
             if self.policy is not None:
                 self._tenants_seen.add(request.tenant)
-            emit_event("serving_request_queued", rid=request.rid,
+            self._emit("serving_request_queued", rid=request.rid,
                        prompt_tokens=len(request.prompt),
                        queue_depth=len(self._queue))
             return True
@@ -1095,7 +1118,7 @@ class ContinuousBatchingScheduler:
         self._live_rids.add(request.rid)
         if self.policy is not None:
             self._tenants_seen.add(request.tenant)
-        emit_event("serving_request_resumed", rid=request.rid,
+        self._emit("serving_request_resumed", rid=request.rid,
                    slot=slot, cached_tokens=exp.length,
                    suspended_s=None)
         return True
@@ -1170,7 +1193,7 @@ class ContinuousBatchingScheduler:
         request = st.request
         covered, entries = self._prefix.match(request.prompt)
         if not covered:
-            emit_event("serving_prefix_miss", rid=request.rid,
+            self._emit("serving_prefix_miss", rid=request.rid,
                        prompt_tokens=len(request.prompt))
             return
         t0 = self._clock()
@@ -1180,7 +1203,7 @@ class ContinuousBatchingScheduler:
             # runs; the whole restore dispatch family is gone
             self.engine.alias_prefix(
                 st.slot, [e.block_id for e in entries], covered)
-            emit_event("serving_block_alias", rid=request.rid,
+            self._emit("serving_block_alias", rid=request.rid,
                        blocks=len(entries), saved_tokens=covered)
         else:
             self.engine.restore_prefix(st.slot,
@@ -1192,7 +1215,7 @@ class ContinuousBatchingScheduler:
         st.prompt_pos = covered
         st.chain = entries[-1].chain
         st.blocks_cached = len(entries)
-        emit_event("serving_prefix_hit", rid=request.rid,
+        self._emit("serving_prefix_hit", rid=request.rid,
                    saved_tokens=covered, blocks=len(entries),
                    prompt_tokens=len(request.prompt),
                    duration_s=round(dt, 6))
@@ -1323,7 +1346,7 @@ class ContinuousBatchingScheduler:
                 dt = self._clock() - t0
                 st.prompt_pos = offset + chunk
                 budget -= chunk
-                emit_event("serving_prefill_chunk", rid=st.request.rid,
+                self._emit("serving_prefill_chunk", rid=st.request.rid,
                            bucket=self.engine.bucket_for(chunk),
                            chunk_tokens=chunk, offset_tokens=offset,
                            duration_s=round(dt, 6))
@@ -1341,7 +1364,7 @@ class ContinuousBatchingScheduler:
                         # the prompt is fully cached: the chain it was
                         # matching/extending no longer needs protection
                         self._release_pins(st)
-                    emit_event("serving_first_token", rid=st.request.rid,
+                    self._emit("serving_first_token", rid=st.request.rid,
                                ttft_s=round(st.t_first - st.t_submit, 6))
                     if self._finish_if_done(st):
                         finished.append(st.request.rid)
@@ -1380,7 +1403,7 @@ class ContinuousBatchingScheduler:
         # stays meaningful for decode-latency diagnosis under load
         decode_s = max(now - st.t_first, 0.0)
         decode_steps = max(len(st.tokens) - 1, 1)
-        emit_event("serving_request_finished", rid=request.rid,
+        self._emit("serving_request_finished", rid=request.rid,
                    finish_reason=result.finish_reason,
                    new_tokens=len(result.tokens),
                    tokens_per_s=round(result.tokens_per_s, 3),
@@ -1450,7 +1473,7 @@ class ContinuousBatchingScheduler:
                     finished.append(request.rid)
                     break
             self._spec_emitted += n_emitted
-            emit_event("serving_spec_verify", rid=request.rid,
+            self._emit("serving_spec_verify", rid=request.rid,
                        bucket=self.engine.draft_bucket_for(len(draft)),
                        drafted=len(draft), accepted=accepted,
                        emitted=n_emitted, duration_s=round(dt, 6))
@@ -1560,8 +1583,34 @@ class ContinuousBatchingScheduler:
             # stream untouched — the escape-hatch identity contract
             obs_bridge.SERVING_SPEC_SPEEDUP.set(
                 self._spec_emitted / self._spec_dispatches)
+        if self.name is not None:
+            # named (fleet) schedulers mirror every per-step gauge into
+            # a {replica=...} series — the process-global series above
+            # stay as the fleet-wide "last stepped" view, the labeled
+            # ones stop replicas clobbering each other.  Same values,
+            # same conditionals, so the attributed series reconcile
+            # exactly with the aggregate ones.
+            r = self.name
+            obs_bridge.SERVING_QUEUE_DEPTH.set(
+                len(self._queue), replica=r)
+            obs_bridge.SERVING_SLOT_OCCUPANCY.set(occupancy, replica=r)
+            obs_bridge.SERVING_CACHE_UTILIZATION.set(
+                cache_util, replica=r)
+            obs_bridge.SERVING_PREFILL_BACKLOG.set(backlog, replica=r)
+            if self._prefix is not None:
+                obs_bridge.SERVING_PREFIX_CACHED_TOKENS.set(
+                    self._prefix.cached_tokens, replica=r)
+            if self._paged:
+                obs_bridge.SERVING_BLOCK_POOL_UTILIZATION.set(
+                    self.engine.block_pool_utilization(), replica=r)
+            obs_bridge.SERVING_DECODE_COMPILES.set(
+                self.engine.decode_compiles(), replica=r)
+            if self._spec_dispatches:
+                obs_bridge.SERVING_SPEC_SPEEDUP.set(
+                    self._spec_emitted / self._spec_dispatches,
+                    replica=r)
         if self._step_index % self.log_interval == 0:
-            emit_event("serving_step", step=self._step_index,
+            self._emit("serving_step", step=self._step_index,
                        queue_depth=len(self._queue),
                        active_slots=len(self._active),
                        slot_occupancy=round(occupancy, 4),

@@ -2173,6 +2173,138 @@ def _obs_metrics(n: int = 50_000, n_series: int = 1000) -> dict:
     }
 
 
+def _obs_fleet_metrics(*, n_requests: int = 18, prompt_len: int = 32,
+                       new_tokens: int = 10, prefill_len: int = 64,
+                       max_len: int = 128, slots: int = 2,
+                       n_replicas: int = 3, kill_step: int = 4,
+                       n_rules: int = 32, n_alert_evals: int = 200,
+                       rounds: int = 3, seed: int = 13) -> dict:
+    """Fleet observability tax (the BENCH_*.json ``obs_fleet`` block,
+    ISSUE 20): what naming every replica (per-replica labeled series),
+    recording hop trails, and evaluating alert rules at each fleet step
+    costs on top of the bare fleet.
+
+    Protocol: the SAME ``KillReplica`` chaos drain the ``serving_fleet``
+    block runs, twice — (1) **bare**: unnamed schedulers, no recorder,
+    no alert engine (today's default path, best-of-``rounds`` wall);
+    (2) **instrumented**: replicas named ``r0..``, a
+    ``RequestTraceRecorder`` installed, and an :class:`AlertEngine`
+    evaluating at every fleet step (best-of-``rounds``).
+    ``overhead_ratio`` is the instrumented/bare wall multiplier (the
+    ≤ 1.10x budget the request-trace layer already holds per-scheduler
+    must hold fleet-wide too).  Alert evaluation is additionally
+    microbenchmarked standalone at ``n_rules`` rules per step
+    (``alert_eval_us_per_step`` — includes the registry snapshot, the
+    real per-step cost), and ``trace_export_ms`` times the per-replica
+    Chrome export of the instrumented run.  ``replica_down`` must fire
+    during the chaos drain; nothing may compile on either leg."""
+    from apex_tpu import obs
+    from apex_tpu.obs.alerts import AlertEngine, ThresholdRule
+    from apex_tpu.resilience.fault_injection import KillReplica
+    from apex_tpu.serving import (ContinuousBatchingScheduler,
+                                  FleetConfig, FleetRouter,
+                                  LoadGenerator, default_prefill_buckets,
+                                  make_workload, zero_overlap_prompts)
+
+    cfg, model, params = _serving_bench_setup(max_len=max_len)
+    warm_lens = [prompt_len] + list(default_prefill_buckets(prefill_len))
+    engines = []
+    for _ in range(n_replicas):
+        eng, _ = _warm_serving_pair(
+            model, params, slots=slots, max_len=max_len,
+            prefill_len=prefill_len, warm_lens=warm_lens,
+            warm_prompt_len=min(prompt_len, max_len - 2))
+        engines.append(eng)
+    compiles_before = [(e.decode_compiles(), e.prefill_compiles())
+                       for e in engines]
+    prompts = zero_overlap_prompts(n_requests, length=prompt_len,
+                                   vocab=cfg.vocab_size, seed=seed)
+    wl = make_workload(prompts, (0.0,) * n_requests,
+                       max_new_tokens=new_tokens, rid_prefix="of",
+                       seed=seed)
+
+    def run(*, instrumented):
+        scheds = {f"r{i}": ContinuousBatchingScheduler(
+            e, max_queue=n_requests, log_interval=10 ** 9,
+            name=(f"r{i}" if instrumented else None))
+            for i, e in enumerate(engines)}
+        alerts = (AlertEngine([ThresholdRule(
+            "replica_down", "apex_serving_fleet_replicas_healthy",
+            "<", n_replicas)]) if instrumented else None)
+        router = FleetRouter(scheds, config=FleetConfig(),
+                             alerts=alerts)
+        hook = KillReplica("r0", at_step=kill_step)
+        if instrumented:
+            with obs.recording_requests() as rec:
+                t0 = time.perf_counter()
+                out = LoadGenerator(router, wl, step_hook=hook).run()
+                wall = time.perf_counter() - t0
+        else:
+            rec = None
+            t0 = time.perf_counter()
+            out = LoadGenerator(router, wl, step_hook=hook).run()
+            wall = time.perf_counter() - t0
+        assert hook.killed, "bench chaos never fired"
+        dropped = out.offered - out.completed - len(out.rejected)
+        assert dropped == 0, f"chaos drain lost {dropped} stream(s)"
+        return wall, rec, alerts
+
+    # 1) bare fleet under chaos — today's default path, best-of-rounds
+    bare_wall = min(run(instrumented=False)[0] for _ in range(rounds))
+    # 2) same chaos, fully instrumented (named replicas + recorder +
+    #    per-step alert evaluation)
+    instr = [run(instrumented=True) for _ in range(rounds)]
+    instr_wall = min(w for w, _, _ in instr)
+    rec, alerts = min(instr, key=lambda r: r[0])[1:]
+    fired = {e["rule"] for e in alerts.ledger
+             if e["transition"] == "firing"}
+    assert "replica_down" in fired, \
+        "kill never fired the replica_down alert"
+
+    t0 = time.perf_counter()
+    trace = rec.to_chrome_trace()
+    trace_export_ms = (time.perf_counter() - t0) * 1e3
+    lanes = {e.get("tid") for e in trace["traceEvents"]
+             if e.get("tid", 0) >= rec.REPLICA_TID_BASE}
+    assert len(lanes) == n_replicas, \
+        f"expected {n_replicas} replica lanes, got {len(lanes)}"
+
+    # 3) standalone alert-evaluation cost at n_rules rules per step
+    #    (rules that never fire: pure evaluation, no transition events)
+    engine = AlertEngine([ThresholdRule(
+        f"bench_rule_{i:02d}", "apex_serving_fleet_replicas_healthy",
+        "<", -1.0) for i in range(n_rules)])
+    t0 = time.perf_counter()
+    for i in range(n_alert_evals):
+        engine.evaluate(now=i * 0.01)
+    alert_eval_us = (time.perf_counter() - t0) / n_alert_evals * 1e6
+    assert not engine.ledger, "the never-fire bench rules transitioned"
+
+    for i, e in enumerate(engines):
+        assert (e.decode_compiles(), e.prefill_compiles()) == \
+            compiles_before[i], f"instrumentation recompiled replica {i}"
+
+    return {
+        "ok": True,
+        "bare_wall_s": round(bare_wall, 4),
+        "instrumented_wall_s": round(instr_wall, 4),
+        "overhead_ratio": round(instr_wall / max(bare_wall, 1e-9), 4),
+        "alert_eval_us_per_step": round(alert_eval_us, 1),
+        "trace_export_ms": round(trace_export_ms, 3),
+        "alerts_firing": len(alerts.firing()),
+        "alert_transitions": len(alerts.ledger),
+        "traced_requests": len(rec.records()),
+        "decode_compiles": sum(e.decode_compiles() for e in engines),
+        "prefill_compiles": sum(e.prefill_compiles() for e in engines),
+        "config": {"n_requests": n_requests, "prompt_len": prompt_len,
+                   "new_tokens": new_tokens, "slots": slots,
+                   "max_len": max_len, "prefill_len": prefill_len,
+                   "kill_step": kill_step, "n_rules": n_rules,
+                   "n_alert_evals": n_alert_evals, "rounds": rounds,
+                   "seed": seed},
+    }
+
+
 def run_config(name: str, *, batch: int | None = None,
                steps: int | None = None, seq: int | None = None) -> dict:
     """Build everything from scratch, run the timing protocol, return the
@@ -2383,6 +2515,11 @@ def run_config(name: str, *, batch: int | None = None,
         obs = _obs_metrics()
     except Exception as e:  # noqa: BLE001 — diagnostic block only
         obs = {"ok": False, "error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        obs_fleet = _obs_fleet_metrics()
+    except Exception as e:  # noqa: BLE001 — diagnostic block only
+        obs_fleet = {"ok": False,
+                     "error": f"{type(e).__name__}: {e}"[:200]}
     return {
         "metric": f"{cfg['metric']}_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
@@ -2408,6 +2545,7 @@ def run_config(name: str, *, batch: int | None = None,
         "serving_fleet": serving_fleet,
         "serving_rollout": serving_rollout,
         "obs": obs,
+        "obs_fleet": obs_fleet,
         "config": out_cfg,
     }
 

@@ -80,6 +80,19 @@ GOLDEN_OLD = {
         "decode_compiles": 3,
         "config": {"canary_window_steps": 16},
     },
+    "obs_fleet": {
+        "ok": True,
+        "bare_wall_s": 0.6,
+        "instrumented_wall_s": 0.63,
+        "overhead_ratio": 1.05,
+        "alert_eval_us_per_step": 80.0,
+        "trace_export_ms": 0.9,
+        "alerts_firing": 1,
+        "alert_transitions": 1,
+        "traced_requests": 18,
+        "decode_compiles": 3,
+        "config": {"n_rules": 32},
+    },
 }
 
 
@@ -232,6 +245,25 @@ class TestClassify:
         assert kinds["serving_quant.max_logit_error"] == "improvement"
         assert kinds["serving_quant.capacity_ratio"] == "improvement"
 
+    def test_obs_fleet_family_direction_aware(self):
+        """The ISSUE-20 obs_fleet block: the instrumented/bare overhead
+        ratio and the alert-eval/trace-export walls grade lower, alert
+        activity counts (rules firing at drain end, ledger transitions,
+        requests recorded) are chaos workload shape — informational
+        inside the family, and untouched elsewhere."""
+        base = "obs_fleet"
+        assert bc.classify(f"{base}.ok") == "exact_higher"
+        assert bc.classify(f"{base}.overhead_ratio") == "lower"
+        assert bc.classify(f"{base}.bare_wall_s") == "lower"
+        assert bc.classify(f"{base}.instrumented_wall_s") == "lower"
+        assert bc.classify(f"{base}.alert_eval_us_per_step") == "lower"
+        assert bc.classify(f"{base}.trace_export_ms") == "lower"
+        assert bc.classify(f"{base}.decode_compiles") == "exact"
+        for count in ("alerts_firing", "alert_transitions",
+                      "traced_requests"):
+            assert bc.classify(f"{base}.{count}") is None, count
+        assert bc.classify(f"{base}.config.n_rules") is None
+
     def test_shed_graded_only_inside_fleet_family(self):
         """``shed`` is a workload-shape activity count everywhere else
         (the policy/SLO blocks) but a GRADED loss inside serving_fleet:
@@ -361,6 +393,29 @@ class TestCompare:
         faster = _mutated(**{"serving_rollout.verdict_latency_s": 0.1})
         assert _kinds(bc.compare(GOLDEN_OLD, faster))[
             "serving_rollout.verdict_latency_s"] == "improvement"
+
+    def test_obs_fleet_regressions_flagged(self):
+        worse = _mutated(**{"obs_fleet.overhead_ratio": 1.30,
+                            "obs_fleet.alert_eval_us_per_step": 200.0,
+                            "obs_fleet.trace_export_ms": 2.0,
+                            "obs_fleet.decode_compiles": 4,
+                            "obs_fleet.alerts_firing": 3,
+                            "obs_fleet.alert_transitions": 7})
+        kinds = _kinds(bc.compare(GOLDEN_OLD, worse))
+        assert kinds["obs_fleet.overhead_ratio"] == "regression"
+        assert kinds["obs_fleet.alert_eval_us_per_step"] == "regression"
+        assert kinds["obs_fleet.trace_export_ms"] == "regression"
+        # a new compile under instrumentation is a retrace, never noise
+        assert kinds["obs_fleet.decode_compiles"] == "regression"
+        # alert activity is chaos workload shape, not a graded rate
+        assert kinds["obs_fleet.alerts_firing"] == "info"
+        assert kinds["obs_fleet.alert_transitions"] == "info"
+        flip = _mutated(**{"obs_fleet.ok": False})
+        assert _kinds(bc.compare(GOLDEN_OLD, flip))[
+            "obs_fleet.ok"] == "regression"
+        better = _mutated(**{"obs_fleet.overhead_ratio": 0.93})
+        assert _kinds(bc.compare(GOLDEN_OLD, better))[
+            "obs_fleet.overhead_ratio"] == "improvement"
 
     def test_missing_graded_metric_flagged(self):
         new = json.loads(json.dumps(GOLDEN_OLD))

@@ -103,6 +103,69 @@ def test_lint_accepts_token_count_histograms():
     assert any("unit" in p for p in problems)
 
 
+_CONVENTIONS = (
+    "\n## Label cardinality\n\n"
+    "| Label | Bound |\n|---|---|\n"
+    "| `op` | fixed vocabulary |\n")
+
+
+def test_lint_accepts_labeled_metric_with_matching_row():
+    assert _check_src(
+        'c = metrics.counter("apex_ops_total", "labeled", ("op",))\n',
+        doc="| `apex_ops_total{op}` | counter | per-op |\n"
+            + _CONVENTIONS) == []
+
+
+def test_lint_collects_scope_labels():
+    """``scope_labels=`` joins ``labelnames`` in the registration's
+    label vocabulary — a doc row must spell both."""
+    regs = check_metrics.collect_from_source(
+        'h = metrics.histogram("apex_lat_seconds", "x", ("op",),\n'
+        '                      scope_labels=("replica",))\n', "sample.py")
+    assert regs[0].labels == ("op", "replica")
+    problems = _check_src(
+        'h = metrics.histogram("apex_lat_seconds", "x", ("op",),\n'
+        '                      scope_labels=("replica",))\n',
+        doc="| `apex_lat_seconds{op}` | histogram | x |\n" + _CONVENTIONS)
+    assert any("['op', 'replica']" in p for p in problems)
+
+
+def test_lint_flags_label_mismatch_both_ways():
+    # registration labeled, doc row bare
+    problems = _check_src(
+        'c = metrics.counter("apex_ops_total", "labeled", ("op",))\n',
+        doc="| `apex_ops_total` | counter | per-op |\n" + _CONVENTIONS)
+    assert any("spell the label names" in p for p in problems)
+    # doc row labeled, registration bare
+    problems = _check_src(
+        'c = metrics.counter("apex_ops_total", "bare")\n',
+        doc="| `apex_ops_total{op}` | counter | per-op |\n" + _CONVENTIONS)
+    assert any("spell the label names" in p for p in problems)
+
+
+def test_lint_flags_undocumented_and_stale_convention_labels():
+    # in-use label with no conventions row
+    problems = _check_src(
+        'c = metrics.counter("apex_ops_total", "labeled", ("op",))\n',
+        doc="| `apex_ops_total{op}` | counter | per-op |\n")
+    assert any("cardinality" in p and "'op'" in p for p in problems)
+    # conventions row for a label nothing uses
+    problems = _check_src(
+        'c = metrics.counter("apex_plain_total", "bare")\n',
+        doc="apex_plain_total\n" + _CONVENTIONS)
+    assert any("stale row" in p for p in problems)
+
+
+def test_lint_reserves_le():
+    """``le`` belongs to histogram exposition: never declarable, never
+    documented as a conventions row, and ignored in doc-row suffixes."""
+    problems = _check_src(
+        'c = metrics.counter("apex_plain_total", "bare")\n',
+        doc="apex_plain_total\n"
+            "\n## Label cardinality\n\n| `le` | bucket edges |\n")
+    assert any("reserved" in p for p in problems)
+
+
 def test_lint_ignores_non_literal_and_unrelated_calls():
     regs = check_metrics.collect_from_source(
         'x = registry.counter(name_var, "dynamic: out of scope")\n'

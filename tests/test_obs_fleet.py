@@ -1,0 +1,565 @@
+"""Fleet-wide observability: per-replica metric attribution,
+cross-replica request traces, and the deterministic SLO alert engine.
+
+THE acceptance run: a 3-replica fleet under ~2x open-loop load with
+``KillReplica`` mid-stream — every record carries its full hop trail
+(placed → failover → resumed with replica names), the Chrome trace
+grows one lane per replica showing the kill and the migration, the
+per-replica metric series reconcile EXACTLY against the fleet
+aggregates, and the alert engine fires ``replica_down`` and
+``goodput_burn`` at deterministic virtual-clock steps — the ledger is
+bit-identical across reruns.  With the recorder and the engine off,
+the event stream and the metric snapshot are byte-identical to an
+unattributed run (the ``replica`` stamp is the ONLY delta a named
+scheduler adds).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import _logging, obs
+from apex_tpu import serving as sv
+from apex_tpu.models import LlamaConfig, LlamaForCausalLM
+from apex_tpu.obs import bridge as obs_bridge
+from apex_tpu.resilience.fault_injection import KillReplica
+
+CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=2, max_position_embeddings=256)
+MAX = 96
+
+
+@pytest.fixture(scope="module")
+def model():
+    return LlamaForCausalLM(CFG)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+
+
+@pytest.fixture(scope="module")
+def _fleet_mod(model, params):
+    return tuple(sv.DecodeEngine(model, params, slots=2, max_len=MAX,
+                                 prefill_len=32) for _ in range(3))
+
+
+@pytest.fixture
+def fleet_engines(_fleet_mod):
+    for e in _fleet_mod:
+        e.reset()
+    return _fleet_mod
+
+
+def _prompt(seed, n=8):
+    return [int(x)
+            for x in np.random.default_rng(seed).integers(0, 128, n)]
+
+
+def _named_fleet(engines, clk, *, named=True, alerts=None, max_queue=8):
+    scheds = {
+        f"r{i}": sv.ContinuousBatchingScheduler(
+            e, max_queue=max_queue, log_interval=10 ** 9, clock=clk,
+            name=(f"r{i}" if named else None))
+        for i, e in enumerate(engines)}
+    return sv.FleetRouter(scheds, config=sv.FleetConfig(), alerts=alerts)
+
+
+class _EventTap:
+    def __init__(self):
+        self.events = []
+
+    def __enter__(self):
+        self._sink = lambda e: self.events.append(dict(e))
+        _logging.add_event_sink(self._sink)
+        return self
+
+    def __exit__(self, *exc):
+        _logging.remove_event_sink(self._sink)
+
+    def of(self, kind):
+        return [e for e in self.events if e.get("event") == kind]
+
+
+def _strip(events, *extra):
+    """Events minus the wall-clock stamp (and any ``extra`` fields) —
+    the comparison basis for byte-identity claims."""
+    drop = {"time", *extra}
+    return [{k: v for k, v in e.items() if k not in drop}
+            for e in events]
+
+
+# ---------------------------------------------------------------------------
+# alert engine units: rules, hysteresis, lifecycle, ledger
+# ---------------------------------------------------------------------------
+
+
+class TestAlertEngineUnits:
+    def test_condition_and_compare_validation(self):
+        assert obs.alerts.compare("<", 2.0, 3.0)
+        assert not obs.Condition(">=", 3.0).holds(2.0)
+        with pytest.raises(ValueError, match="unknown comparison op"):
+            obs.alerts.compare("~", 1.0, 1.0)
+        with pytest.raises(ValueError, match="unknown comparison op"):
+            obs.Condition("=<", 1.0)
+        with pytest.raises(ValueError, match="unknown comparison op"):
+            # a typo'd rule fails at definition, not silently never fires
+            obs.ThresholdRule("bad", "x", "=<", 1.0)
+
+    def test_duplicate_rule_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate alert rule"):
+            obs.AlertEngine([
+                obs.ThresholdRule("dup", "x", "<", 1.0),
+                obs.ThresholdRule("dup", "y", ">", 2.0)])
+
+    def test_burn_rate_validation(self):
+        sel = obs.Selector("x")
+        with pytest.raises(ValueError, match="objective"):
+            obs.BurnRateRule("b", good=sel, total=sel, objective=1.0,
+                             long_window_s=4.0, short_window_s=1.0,
+                             factor=2.0)
+        with pytest.raises(ValueError, match="exceeds long window"):
+            obs.BurnRateRule("b", good=sel, total=sel, objective=0.9,
+                             long_window_s=1.0, short_window_s=4.0,
+                             factor=2.0)
+
+    def test_threshold_lifecycle_with_hysteresis(self):
+        reg = obs.MetricsRegistry()
+        g = reg.gauge("apex_unit_healthy", "")
+        clk = sv.VirtualClock()
+        engine = obs.AlertEngine(
+            [obs.ThresholdRule("down", "apex_unit_healthy", "<", 3,
+                               for_duration_s=0.5)],
+            clock=clk, registry=reg)
+        g.set(3)
+        assert engine.evaluate() == [] and engine.firing() == []
+        # condition holds but for_duration_s not yet served: PENDING
+        g.set(2)
+        clk.advance(0.25)
+        assert engine.evaluate() == []
+        clk.advance(0.25)
+        assert engine.evaluate() == []          # age 0.25 < 0.5
+        clk.advance(0.25)
+        (fired,) = engine.evaluate()
+        assert fired["rule"] == "down"
+        assert fired["transition"] == "firing"
+        assert fired["value"] == 2.0
+        assert engine.firing() == ["down"]
+        # still holding: no second firing entry
+        clk.advance(0.25)
+        assert engine.evaluate() == []
+        g.set(3)
+        clk.advance(0.25)
+        (resolved,) = engine.evaluate()
+        assert resolved["transition"] == "resolved"
+        assert resolved["value"] is None
+        assert engine.firing() == []
+        # a dip shorter than the hold never fires (hysteresis)
+        g.set(2)
+        clk.advance(0.25)
+        assert engine.evaluate() == []
+        g.set(3)
+        clk.advance(0.25)
+        assert engine.evaluate() == []
+        assert [e["transition"] for e in engine.ledger] \
+            == ["firing", "resolved"]
+
+    def test_absence_rule_missing_then_frozen(self):
+        reg = obs.MetricsRegistry()
+        g = reg.gauge("apex_unit_beat", "")
+        clk = sv.VirtualClock()
+        engine = obs.AlertEngine(
+            [obs.AbsenceRule("stale", "apex_unit_beat", stale_after_s=1.0)],
+            clock=clk, registry=reg)
+        # a never-seen series: stale since the engine first looked
+        assert engine.evaluate() == []
+        clk.advance(1.0)
+        (fired,) = engine.evaluate()
+        assert fired["transition"] == "firing"
+        # the series appears and changes: resolves
+        g.set(1.0)
+        clk.advance(0.25)
+        (resolved,) = engine.evaluate()
+        assert resolved["transition"] == "resolved"
+        # ...then freezes (a wedged emitter): stale again after the age
+        for _ in range(4):
+            clk.advance(0.25)
+            engine.evaluate()
+        assert engine.firing() == ["stale"]
+        g.set(2.0)
+        clk.advance(0.25)
+        engine.evaluate()
+        assert engine.firing() == []
+
+    def test_burn_rate_fires_on_both_windows_only(self):
+        reg = obs.MetricsRegistry()
+        good = reg.counter("apex_unit_good_total", "")
+        total = reg.counter("apex_unit_total_total", "")
+        clk = sv.VirtualClock()
+        engine = obs.AlertEngine(
+            [obs.BurnRateRule("burn",
+                              good=obs.Selector("apex_unit_good_total"),
+                              total=obs.Selector("apex_unit_total_total"),
+                              objective=0.9, long_window_s=4.0,
+                              short_window_s=1.0, factor=5.0)],
+            clock=clk, registry=reg)
+        good.inc(0)
+        total.inc(0)
+        engine.evaluate()                       # seed sample (0, 0)
+        good.inc(5)
+        total.inc(5)
+        clk.advance(0.5)
+        assert engine.evaluate() == []          # all good: burn 0
+        total.inc(5)                            # 5 bad events
+        clk.advance(0.5)
+        (fired,) = engine.evaluate()
+        assert fired["transition"] == "firing"
+        # bad_frac 0.5 over both windows / 0.1 error budget = burn 5.0
+        assert fired["value"] == 5.0
+        # traffic turns good again: the short window clears first and
+        # the AND gate resolves even while the long window still burns
+        good.inc(10)
+        total.inc(10)
+        clk.advance(1.0)
+        (resolved,) = engine.evaluate()
+        assert resolved["transition"] == "resolved"
+
+    def test_histogram_bucket_selector(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("apex_unit_lat_seconds", "")
+        h.observe(0.1)
+        h.observe(0.1)
+        h.observe(5.0)
+        snap = reg.snapshot()
+        fast = obs.Selector("apex_unit_lat_seconds", le=0.2).value(snap)
+        assert fast == 2.0                      # cumulative fast bucket
+        assert obs.Selector("apex_unit_lat_seconds").value(snap) == 3.0
+        # le past the last finite edge degrades to the total count
+        assert obs.Selector("apex_unit_lat_seconds",
+                            le=1e9).value(snap) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# bounded label scopes + snapshot filtering (metrics units)
+# ---------------------------------------------------------------------------
+
+
+class TestScopeLabels:
+    def test_scope_bound_enforced(self):
+        reg = obs.MetricsRegistry()
+        h = reg.histogram("apex_scope_h_seconds", "",
+                          scope_labels=("replica",))
+        h.observe(1.0)                          # unlabeled: always legal
+        with pytest.raises(ValueError, match="no declared"):
+            h.observe(1.0, replica="a")
+        reg.declare_scope("replica", 2)
+        h.observe(1.0, replica="a")
+        h.observe(1.0, replica="b")
+        with pytest.raises(ValueError, match="cardinality bound"):
+            h.observe(1.0, replica="c")
+        # established series keep updating at the full bound
+        h.observe(2.0, replica="a")
+        assert h.count(replica="a") == 2
+        assert h.count() == 1
+
+    def test_declare_scope_widens_only(self):
+        reg = obs.MetricsRegistry()
+        reg.declare_scope("replica", 3)
+        reg.declare_scope("replica", 1)         # narrowing is a no-op
+        assert reg.scope_bound("replica") == 3
+        reg.declare_scope("replica", 5)
+        assert reg.scope_bound("replica") == 5
+
+    def test_snapshot_name_filter(self):
+        reg = obs.MetricsRegistry()
+        reg.counter("apex_filt_a_total", "").inc()
+        reg.counter("apex_filt_b_total", "").inc()
+        snap = reg.snapshot(names=["apex_filt_a_total", "no_such_metric"])
+        assert set(snap) == {"apex_filt_a_total"}
+        assert set(reg.snapshot()) == {"apex_filt_a_total", "apex_filt_b_total"}
+
+
+# ---------------------------------------------------------------------------
+# naming: scheduler validation + fleet uniqueness
+# ---------------------------------------------------------------------------
+
+
+class TestNaming:
+    def test_scheduler_name_validated(self, fleet_engines):
+        for bad in ("", 7):
+            with pytest.raises(ValueError, match="non-empty string"):
+                sv.ContinuousBatchingScheduler(fleet_engines[0],
+                                               name=bad)
+
+    def test_fleet_rejects_duplicate_scheduler_names(self, fleet_engines):
+        e0, e1, _ = fleet_engines
+        clk = sv.VirtualClock()
+        s0 = sv.ContinuousBatchingScheduler(e0, clock=clk, name="twin")
+        s1 = sv.ContinuousBatchingScheduler(e1, clock=clk, name="twin")
+        with pytest.raises(ValueError, match="unique names"):
+            sv.FleetRouter({"a": s0, "b": s1})
+
+
+# ---------------------------------------------------------------------------
+# per-replica reconciliation: labeled series vs fleet aggregates
+# ---------------------------------------------------------------------------
+
+
+class TestPerReplicaReconciliation:
+    def test_clean_drain_reconciles_exactly(self, fleet_engines):
+        """Satellite: a clean 3-replica drain — the sum of each
+        metric's ``{replica=...}`` series equals its fleet-aggregate
+        series EXACTLY (same events, dual-written), and
+        ``replica_reports()`` per-replica sample counts match the
+        labeled histogram counts."""
+        obs.metrics.reset()
+        clk = sv.VirtualClock()
+        router = _named_fleet(fleet_engines, clk)
+        n = 6
+        wl = sv.make_workload([_prompt(400 + i) for i in range(n)],
+                              sv.uniform_arrivals(n, 12.0),
+                              max_new_tokens=4, deadline_s=30.0,
+                              rid_prefix="rc")
+        with obs.recording_requests(clock=clk) as rec:
+            out = sv.LoadGenerator(router, wl, step_time_s=0.25).run()
+        assert out.completed == n
+        names = ("r0", "r1", "r2")
+        for metric in (obs_bridge.SERVING_TTFT,
+                       obs_bridge.SERVING_QUEUE_WAIT,
+                       obs_bridge.SERVING_PER_TOKEN):
+            agg = metric.count()
+            assert agg == sum(metric.count(replica=r) for r in names), \
+                metric.name
+            assert agg == n, metric.name
+            assert metric.sum() == pytest.approx(
+                sum(metric.sum(replica=r) for r in names), rel=1e-12)
+        assert sum(obs_bridge.SERVING_FLEET_ROUTED.value(replica=r)
+                   for r in names) == n
+        reports = router.replica_reports(
+            rec.records(), deadlines=out.deadlines,
+            arrivals=out.arrivals, duration_s=out.duration_s)
+        per = {k: v for k, v in reports.items() if k != "fleet"}
+        assert sum(r.completed for r in per.values()) == n
+        for name, rep in per.items():
+            assert rep.ttft["n"] == obs_bridge.SERVING_TTFT.count(
+                replica=name) == rep.completed
+            assert rep.queue_wait["n"] \
+                == obs_bridge.SERVING_QUEUE_WAIT.count(replica=name)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance run: chaos drain with traces, lanes, and alerts
+# ---------------------------------------------------------------------------
+
+
+class TestFleetChaosObservability:
+    N = 12
+    KILL_STEP = 6
+    #: the "fast enough" TTFT bound (snaps to the 0.3162s bucket edge)
+    TTFT_GOOD_S = 0.3
+
+    def _rules(self, clk):
+        return obs.AlertEngine(
+            [obs.ThresholdRule(
+                "replica_down",
+                "apex_serving_fleet_replicas_healthy", "<", 3),
+             obs.BurnRateRule(
+                "goodput_burn",
+                good=obs.Selector("apex_serving_ttft_seconds",
+                                  le=self.TTFT_GOOD_S),
+                total=obs.Selector("apex_serving_ttft_seconds"),
+                objective=0.99, long_window_s=2.0,
+                short_window_s=0.5, factor=8.0)],
+            clock=clk)
+
+    def _chaos_run(self, engines):
+        """One full chaos scenario on the shared virtual clock: 3-named
+        -replica fleet, ~2x open-loop load, r0 hard-killed mid-stream,
+        then r0 replaced and the fleet stepped until every alert
+        resolves.  Returns everything the assertions need."""
+        for e in engines:
+            e.reset()
+        obs.metrics.reset()
+        clk = sv.VirtualClock()
+        alerts = self._rules(clk)
+        router = _named_fleet(engines, clk, alerts=alerts)
+        wl = sv.make_workload(
+            [_prompt(100 + i) for i in range(self.N)],
+            sv.uniform_arrivals(self.N, 8.0),
+            max_new_tokens=5, deadline_s=60.0, rid_prefix="fo")
+        fault = KillReplica("r0", at_step=self.KILL_STEP)
+        with obs.recording_requests(clock=clk) as rec, \
+                _EventTap() as tap:
+            out = sv.LoadGenerator(router, wl, step_time_s=0.25,
+                                   step_hook=fault).run()
+            assert fault.killed
+            assert router.replicas_healthy == 2
+            # recovery: a rebuilt r0 replaces the dead scheduler, and
+            # the burn's trailing windows drain — both alerts resolve
+            # at deterministic virtual-clock steps
+            fresh = sv.ContinuousBatchingScheduler(
+                router.replica("r0").engine, max_queue=8,
+                log_interval=10 ** 9, clock=clk, name="r0")
+            router.replace("r0", fresh)
+            for _ in range(12):
+                router.step()
+                clk.advance(0.25)
+        return out, rec, tap, alerts
+
+    def test_chaos_traces_lanes_alerts_and_reconciliation(
+            self, fleet_engines):
+        out, rec, tap, alerts = self._chaos_run(fleet_engines)
+        names = ("r0", "r1", "r2")
+        assert out.rejected == []
+        for rid, res in out.results.items():
+            assert res.finish_reason in sv.SERVED_REASONS, rid
+
+        # -- hop trails: every record placed; victims migrated --------
+        records = rec.records()
+        assert len(records) == self.N
+        assert all(st.hops and st.hops[0]["kind"] == "placed"
+                   and st.replica in names for st in records)
+        victims = [st for st in records
+                   if any(h["kind"] == "failover" for h in st.hops)]
+        assert victims                          # the kill hit live work
+        for st in victims:
+            kinds = [h["kind"] for h in st.hops]
+            assert kinds.index("failover") > kinds.index("placed")
+            assert "resumed" in kinds
+            resumed = [h for h in st.hops if h["kind"] == "resumed"]
+            assert resumed[-1]["from_replica"] == "r0"
+            assert st.replica == resumed[-1]["replica"] != "r0"
+
+        # -- Chrome trace: one lane per replica, kill + migration -----
+        trace = rec.to_chrome_trace()
+        evs = trace["traceEvents"]
+        base = obs.RequestTraceRecorder.REPLICA_TID_BASE
+        lanes = {e["args"]["name"]: e["tid"] for e in evs
+                 if e.get("ph") == "M" and e["name"] == "thread_name"
+                 and e["tid"] >= base}
+        assert lanes == {f"replica {r}": base + i
+                         for i, r in enumerate(names)}
+        # the kill renders as a health band on r0's lane
+        assert any(e["name"] == "health:dead"
+                   and e["tid"] == lanes["replica r0"]
+                   for e in evs if e.get("ph") == "i")
+        # a victim's residency: a span on r0 ended by the failover,
+        # then a span on the survivor lane — the migration is visible
+        v = victims[0]
+        spans = [e for e in evs if e.get("ph") == "X"
+                 and e["name"] == v.rid and e["tid"] >= base]
+        assert len({e["tid"] for e in spans}) >= 2
+        assert any(e.get("args", {}).get("ended_by") == "failover"
+                   and e["tid"] == lanes["replica r0"] for e in spans)
+
+        # -- exact per-replica reconciliation under chaos --------------
+        for metric in (obs_bridge.SERVING_TTFT,
+                       obs_bridge.SERVING_QUEUE_WAIT,
+                       obs_bridge.SERVING_PER_TOKEN):
+            assert metric.count() == sum(metric.count(replica=r)
+                                         for r in names), metric.name
+        assert obs_bridge.SERVING_TTFT.count() >= self.N
+        assert sum(obs_bridge.SERVING_FLEET_ROUTED.value(replica=r)
+                   for r in names) >= self.N
+
+        # -- the alert story: both rules fired AND resolved ------------
+        ledger = alerts.ledger
+        by_rule = {r: [e["transition"] for e in ledger
+                       if e["rule"] == r]
+                   for r in ("replica_down", "goodput_burn")}
+        assert by_rule["replica_down"] == ["firing", "resolved"]
+        assert by_rule["goodput_burn"][:1] == ["firing"]
+        assert by_rule["goodput_burn"][-1] == "resolved"
+        assert alerts.firing() == []
+        down = [e for e in ledger if e["rule"] == "replica_down"]
+        # the kill hook runs after the KILL_STEP router step, so the
+        # healthy gauge crosses on the NEXT step's evaluation — firing
+        # is pinned to that virtual-clock instant
+        assert down[0]["t"] == pytest.approx((self.KILL_STEP + 1) * 0.25)
+        # the events reached the bridge: gauge cleared, every
+        # transition counted
+        for rule in ("replica_down", "goodput_burn"):
+            assert obs_bridge.SERVING_ALERTS_FIRING.value(
+                rule=rule) == 0
+        assert obs_bridge.SERVING_ALERT_TRANSITIONS.value() \
+            == len(ledger)
+        assert len(tap.of("serving_alert_firing")) \
+            + len(tap.of("serving_alert_resolved")) == len(ledger)
+
+        # -- determinism: the rerun's ledger is bit-identical ----------
+        out2, _, _, alerts2 = self._chaos_run(fleet_engines)
+        assert alerts2.ledger == ledger
+        assert {r: v.tokens for r, v in out2.results.items()} \
+            == {r: v.tokens for r, v in out.results.items()}
+
+
+# ---------------------------------------------------------------------------
+# default-off identity: attribution is the ONLY event-stream delta
+# ---------------------------------------------------------------------------
+
+
+class TestDefaultOffIdentity:
+    def _run(self, engines, *, named, instrumented=False):
+        for e in engines:
+            e.reset()
+        obs.metrics.reset()
+        clk = sv.VirtualClock()
+        alerts = (obs.AlertEngine(
+            [obs.ThresholdRule("replica_down",
+                               "apex_serving_fleet_replicas_healthy",
+                               "<", 3)], clock=clk)
+            if instrumented else None)
+        router = _named_fleet(engines, clk, named=named, alerts=alerts)
+        wl = sv.make_workload([_prompt(300 + i) for i in range(6)],
+                              sv.uniform_arrivals(6, 6.0),
+                              max_new_tokens=3, deadline_s=30.0,
+                              rid_prefix="id")
+        rec = (obs.RequestTraceRecorder(clock=clk).install()
+               if instrumented else None)
+        try:
+            with _EventTap() as tap:
+                out = sv.LoadGenerator(router, wl,
+                                       step_time_s=0.25).run()
+        finally:
+            if rec is not None:
+                rec.uninstall()
+        assert out.completed == 6
+        return tap.events, obs.snapshot(), rec, alerts
+
+    def test_unattributed_run_is_byte_identical(self, fleet_engines):
+        """Two unnamed, recorder-less, alert-less runs: the event
+        stream (modulo the wall-clock stamp) and the metric snapshot
+        are byte-identical — and carry no replica attribution at all."""
+        ev1, snap1, _, _ = self._run(fleet_engines, named=False)
+        ev2, snap2, _, _ = self._run(fleet_engines, named=False)
+        assert _strip(ev1) == _strip(ev2)
+        assert snap1 == snap2
+        # scheduler lifecycle events carry no replica stamp (the
+        # router's own fleet events name replicas by design)
+        for e in ev1:
+            if e["event"].startswith("serving_request"):
+                assert "replica" not in e, e["event"]
+        for series in snap1["apex_serving_ttft_seconds"]["series"]:
+            assert series["labels"] == {}
+
+    def test_attribution_is_the_only_delta(self, fleet_engines):
+        """The fully instrumented run (named schedulers + recorder +
+        alert engine with no rule firing) emits the SAME event stream
+        as the bare run, except for the ``replica`` stamp — the
+        recorder and the engine are pure observers."""
+        ev_plain, _, _, _ = self._run(fleet_engines, named=False)
+        ev_inst, _, rec, alerts = self._run(fleet_engines, named=True,
+                                            instrumented=True)
+        assert alerts.ledger == []              # healthy fleet: silent
+        assert _strip(ev_inst, "replica") == _strip(ev_plain, "replica")
+        # ...and the stamp is really there on the instrumented side
+        finished = [e for e in ev_inst
+                    if e["event"] == "serving_request_finished"]
+        assert finished and all(
+            e["replica"] in ("r0", "r1", "r2") for e in finished)
+        records = rec.records()
+        assert len(records) == 6
+        assert all(st.replica in ("r0", "r1", "r2") for st in records)

@@ -171,6 +171,15 @@ def classify(path: str) -> Optional[str]:
         if (segments[-1] == "max_logit_error"
                 or "bytes_per_token" in segments[-1]):
             return "lower"
+    # family-scoped override: inside the obs_fleet block the alert
+    # activity counts (rules left firing at drain end, ledger
+    # transitions, requests recorded) are chaos workload shape, not
+    # graded rates — the graded outcomes are the instrumented/bare
+    # overhead ratio and the alert-eval/trace-export walls, which ride
+    # the generic lower-is-better families
+    if "obs_fleet" in segments and segments[-1] in (
+            "alerts_firing", "alert_transitions", "traced_requests"):
+        return None
     if segments[-1] in _INFORMATIONAL_EXACT:
         return None
     for seg in reversed(segments):

@@ -24,6 +24,12 @@ This lint closes the loop statically:
 3. a *non-literal* kind (a variable) is flagged too: dynamic kinds
    can't be linted, and none exist in-tree.
 
+One sanctioned indirection: a method named ``_emit`` is a declared
+emit *wrapper* (the serving scheduler's replica-stamping wrapper) —
+its call sites are linted exactly like ``emit_event`` calls, and the
+single forwarding ``emit_event(kind, ...)`` inside its body is exempt
+from the literal-kind rule (the literals live at the call sites).
+
 Run directly (``python tools/check_events.py``) or through tier-1
 (``tests/test_lint_events.py``).  Scope is ``apex_tpu/`` only — tests
 emit throwaway kinds into private sinks.
@@ -107,21 +113,38 @@ def _is_emit_event(node: ast.Call) -> bool:
     if isinstance(func, ast.Name):
         return func.id == "emit_event"
     if isinstance(func, ast.Attribute):
-        return func.attr == "emit_event"
+        # self._emit("kind", ...) — the sanctioned wrapper indirection
+        return func.attr in ("emit_event", "_emit")
     return False
 
 
+def _wrapper_spans(tree: ast.AST) -> List[tuple]:
+    """Line spans of ``_emit`` method bodies — the one place a
+    forwarded non-literal kind is sanctioned."""
+    spans = []
+    for node in ast.walk(tree):
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == "_emit"):
+            spans.append((node.lineno, node.end_lineno or node.lineno))
+    return spans
+
+
 def collect_emits_from_source(source: str, relpath: str) -> List[Emit]:
-    """Every ``emit_event(...)`` call's first positional argument."""
+    """Every ``emit_event(...)`` / ``self._emit(...)`` call's first
+    positional argument."""
     try:
         tree = ast.parse(source, filename=relpath)
     except SyntaxError as e:
         return [Emit(f"<syntax error: {e.msg}>", relpath,
                      e.lineno or 0, False)]
+    wrappers = _wrapper_spans(tree)
     out: List[Emit] = []
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or not _is_emit_event(node):
             continue
+        if any(lo <= node.lineno <= hi for lo, hi in wrappers) and not (
+                node.args and isinstance(node.args[0], ast.Constant)):
+            continue                    # the wrapper's forwarding call
         if not node.args:
             out.append(Emit("<missing kind argument>", relpath,
                             node.lineno, False))

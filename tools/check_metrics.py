@@ -20,7 +20,15 @@ alert on what they can look up).  This lint pins all three statically:
 5. the reverse: every row of the doc's metric-inventory table names a
    metric that is actually registered — a deleted metric must take its
    documentation row with it (operators alert on what they can look
-   up, and a stale row is an alert that can never fire).
+   up, and a stale row is an alert that can never fire);
+6. **label cardinality**: a labeled metric's inventory row must spell
+   its label names inside the backticks (``apex_events_total{event}``),
+   matching the registration's ``labelnames`` + ``scope_labels``
+   exactly — and every label name in use must have a row in the doc's
+   "Label cardinality" conventions table stating its bound (``replica``
+   and ``rule`` join ``tenant`` as bounded vocabularies).  Stale and
+   undocumented labels are flagged both ways; ``le`` is reserved for
+   histogram exposition and never documented as a label.
 
 Run directly (``python tools/check_metrics.py``) or through tier-1
 (``tests/test_lint_metrics.py``).  Scope is ``apex_tpu/`` only: tests
@@ -57,6 +65,7 @@ class Registration(NamedTuple):
     kind: str       # counter | gauge | histogram
     relpath: str
     lineno: int
+    labels: tuple = ()   # labelnames + scope_labels, declared order
 
 
 def _call_kind(node: ast.Call) -> str | None:
@@ -66,6 +75,32 @@ def _call_kind(node: ast.Call) -> str | None:
     if isinstance(func, ast.Attribute) and func.attr in _METRIC_FUNCS:
         return func.attr
     return None
+
+
+def _literal_strings(node: ast.AST | None) -> tuple:
+    """String elements of a literal tuple/list (anything else — a
+    variable, a computed value — contributes nothing; none exist
+    in-tree)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return ()
+    return tuple(e.value for e in node.elts
+                 if isinstance(e, ast.Constant)
+                 and isinstance(e.value, str))
+
+
+def _call_labels(node: ast.Call) -> tuple:
+    """The registration's full label vocabulary: ``labelnames`` (third
+    positional or keyword) followed by ``scope_labels`` (keyword) —
+    declared order, matching how series render."""
+    labelnames = (_literal_strings(node.args[2])
+                  if len(node.args) > 2 else ())
+    scope = ()
+    for kw in node.keywords:
+        if kw.arg == "labelnames":
+            labelnames = _literal_strings(kw.value)
+        elif kw.arg == "scope_labels":
+            scope = _literal_strings(kw.value)
+    return labelnames + scope
 
 
 def collect_from_source(source: str, relpath: str) -> List[Registration]:
@@ -88,7 +123,7 @@ def collect_from_source(source: str, relpath: str) -> List[Registration]:
         first = node.args[0]
         if isinstance(first, ast.Constant) and isinstance(first.value, str):
             out.append(Registration(first.value, kind, relpath,
-                                    first.lineno))
+                                    first.lineno, _call_labels(node)))
     return out
 
 
@@ -112,17 +147,47 @@ def collect() -> List[Registration]:
 
 
 # an inventory-table row: first cell is the backticked metric name,
-# optionally with a {label} suffix inside the backticks
-_DOC_ROW_RE = re.compile(r"^\|\s*`(apex_[a-z0-9_]+)[^`]*`\s*\|")
+# optionally with a {label,label} suffix inside the backticks
+_DOC_ROW_RE = re.compile(
+    r"^\|\s*`(apex_[a-z0-9_]+)(?:\{([a-z0-9_,\s]*)\})?`\s*\|")
+# a "Label cardinality" conventions-table row: first cell is the
+# backticked label name
+_LABEL_ROW_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+#: reserved by the histogram text exposition — never a declarable label
+_RESERVED_LABELS = frozenset(("le",))
 
 
-def documented_inventory(doc_text: str) -> List[tuple[str, int]]:
-    """``(metric name, line number)`` for every inventory-table row in
-    the docs page (prose mentions are not rows and are not scanned)."""
+def documented_inventory(doc_text: str
+                         ) -> List[tuple[str, int, tuple]]:
+    """``(metric name, line number, label names)`` for every
+    inventory-table row in the docs page (prose mentions are not rows
+    and are not scanned)."""
     out = []
     for lineno, line in enumerate(doc_text.splitlines(), start=1):
         m = _DOC_ROW_RE.match(line.strip())
         if m:
+            labels = tuple(s.strip() for s in (m.group(2) or "").split(",")
+                           if s.strip())
+            out.append((m.group(1), lineno, labels))
+    return out
+
+
+def documented_label_conventions(doc_text: str
+                                 ) -> List[tuple[str, int]]:
+    """``(label name, line number)`` rows of the docs page's "Label
+    cardinality" conventions table (the section heading opens it, the
+    next heading closes it)."""
+    out = []
+    in_section = False
+    for lineno, line in enumerate(doc_text.splitlines(), start=1):
+        stripped = line.strip()
+        if stripped.startswith("#"):
+            in_section = "label cardinality" in stripped.lower()
+            continue
+        if not in_section:
+            continue
+        m = _LABEL_ROW_RE.match(stripped)
+        if m and not m.group(1).startswith("apex_"):
             out.append((m.group(1), lineno))
     return out
 
@@ -160,6 +225,10 @@ def check(regs: List[Registration], doc_text: str | None) -> List[str]:
             f"missing {os.path.relpath(DOC, REPO)} — run "
             f"tools/gen_api_docs.py (every metric must be documented)")
     else:
+        doc_rel = os.path.relpath(DOC, REPO)
+        rows = documented_inventory(doc_text)
+        doc_labels = {name: (labels, lineno)
+                      for name, lineno, labels in rows}
         for name in sorted(by_name):
             # word-bounded: `apex_serving_tokens` must NOT pass just
             # because `apex_serving_tokens_per_second` is documented
@@ -167,17 +236,54 @@ def check(regs: List[Registration], doc_text: str | None) -> List[str]:
                     rf"\b{re.escape(name)}\b(?![a-z0-9_])", doc_text):
                 problems.append(
                     f"metric {name!r} is not documented in "
-                    f"{os.path.relpath(DOC, REPO)} (add it to the "
+                    f"{doc_rel} (add it to the "
                     f"inventory table in gen_api_docs.py PAGE_PROLOGUE "
                     f"and regenerate)")
         # the reverse direction: no stale inventory rows
-        for name, lineno in documented_inventory(doc_text):
+        for name, lineno, _ in rows:
             if name not in by_name:
                 problems.append(
-                    f"{os.path.relpath(DOC, REPO)}:{lineno}: inventory "
+                    f"{doc_rel}:{lineno}: inventory "
                     f"row documents {name!r} but no registration "
                     f"exists under apex_tpu/ — remove the row from "
                     f"gen_api_docs.py PAGE_PROLOGUE and regenerate")
+        # label cardinality: each labeled metric's row spells its label
+        # names; the set must match the registration exactly both ways
+        used_labels: set[str] = set()
+        for name, sites in sorted(by_name.items()):
+            reg_labels = set(sites[0].labels) - _RESERVED_LABELS
+            used_labels |= reg_labels
+            if name not in doc_labels:
+                continue                # missing-row already reported
+            documented, lineno = doc_labels[name]
+            documented_set = set(documented) - _RESERVED_LABELS
+            if documented_set != reg_labels:
+                problems.append(
+                    f"{doc_rel}:{lineno}: {name!r} documents labels "
+                    f"{sorted(documented_set)} but the registration "
+                    f"declares {sorted(reg_labels)} — the inventory "
+                    f"row's {{...}} suffix must spell the label names "
+                    f"exactly (labelnames + scope_labels)")
+        # every in-use label needs a cardinality-conventions row, and
+        # every conventions row must name a label still in use
+        conventions = documented_label_conventions(doc_text)
+        documented_label_names = {name for name, _ in conventions}
+        for label in sorted(used_labels - documented_label_names):
+            problems.append(
+                f"label {label!r} is used by a registration but has no "
+                f"row in the {doc_rel} \"Label cardinality\" "
+                f"conventions table — every label needs a documented "
+                f"cardinality bound")
+        for label, lineno in conventions:
+            if label in _RESERVED_LABELS:
+                problems.append(
+                    f"{doc_rel}:{lineno}: {label!r} is reserved for "
+                    f"histogram exposition — remove the conventions row")
+            elif label not in used_labels:
+                problems.append(
+                    f"{doc_rel}:{lineno}: conventions row documents "
+                    f"label {label!r} but no registration uses it — "
+                    f"remove the stale row")
     return problems
 
 

@@ -1256,7 +1256,13 @@ Enforced at registration (`obs.metrics`) **and** statically by
 - each name is registered at exactly **one** call site (declare the
   instrument once at module level, import the object everywhere else);
 - each name appears in the inventory below (the lint cross-checks this
-  page, so the table cannot rot).
+  page, so the table cannot rot);
+- a labeled metric's inventory row spells its label names inside the
+  backticks (`apex_events_total{event}`), matching the registration's
+  `labelnames` + `scope_labels` exactly, and every label in use has a
+  row in the "Label cardinality" table below stating its bound — both
+  cross-checked both ways by the lint, so a new label cannot ship
+  without a documented cardinality budget.
 
 Label names match `[a-z_][a-z0-9_]*`; keep cardinality bounded (label
 by event kind or call site, never by request id or step number).
@@ -1283,32 +1289,32 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_checkpoint_inflight` | gauge | `AsyncCheckpointer` (at most one write in flight per pipeline; concurrent pipelines sum) |
 | `apex_checkpoint_backpressure_total` | counter | async saves that joined a still-running previous write |
 | `apex_checkpoints_rejected_total` | counter | `checkpoint_rejected` events |
-| `apex_serving_ttft_seconds` | histogram | `serving_first_token` events |
-| `apex_serving_queue_wait_seconds` | histogram | `serving_request_admitted` events (submit → slot admission; the queueing component of TTFT) |
+| `apex_serving_ttft_seconds{replica}` | histogram | `serving_first_token` events |
+| `apex_serving_queue_wait_seconds{replica}` | histogram | `serving_request_admitted` events (submit → slot admission; the queueing component of TTFT) |
 | `apex_serving_goodput_ratio` | gauge | `serving.loadgen` (requests meeting their deadline / offered, for the most recent deadline-carrying open-loop run) |
 | `apex_serving_prefill_duration_seconds{bucket}` | histogram | `serving_prefill_chunk` events (label = bucket size; bounded by the engine's bucket table) |
-| `apex_serving_decode_per_token_seconds` | histogram | `serving_request_finished` events |
-| `apex_serving_tokens_per_second` | gauge | last finished request |
-| `apex_serving_queue_depth` | gauge | scheduler, every step |
-| `apex_serving_slot_occupancy` | gauge | scheduler, every step |
-| `apex_serving_cache_utilization` | gauge | `DecodeEngine.cache_utilization()`, every step |
-| `apex_serving_decode_compiles` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
-| `apex_serving_prefill_backlog` | gauge | scheduler, every step (prompt tokens deferred by the prefill budget) |
+| `apex_serving_decode_per_token_seconds{replica}` | histogram | `serving_request_finished` events |
+| `apex_serving_tokens_per_second{replica}` | gauge | last finished request |
+| `apex_serving_queue_depth{replica}` | gauge | scheduler, every step |
+| `apex_serving_slot_occupancy{replica}` | gauge | scheduler, every step |
+| `apex_serving_cache_utilization{replica}` | gauge | `DecodeEngine.cache_utilization()`, every step |
+| `apex_serving_decode_compiles{replica}` | gauge | `DecodeEngine.decode_compiles()` (1 == shape-stable) |
+| `apex_serving_prefill_backlog{replica}` | gauge | scheduler, every step (prompt tokens deferred by the prefill budget) |
 | `apex_serving_prefix_hit_total` | counter | `serving_prefix_hit` events (admissions that restored a cached prompt prefix) |
 | `apex_serving_prefix_miss_total` | counter | `serving_prefix_miss` events (admissions with no cached prefix to reuse) |
 | `apex_serving_prefix_saved_tokens` | histogram | `serving_prefix_hit` events (prompt tokens restored per hit — prefill work not re-run; token-count buckets) |
-| `apex_serving_prefix_cached_tokens` | gauge | scheduler, every step while prefix caching is enabled (tokens of K/V held by the cross-request prefix cache) |
+| `apex_serving_prefix_cached_tokens{replica}` | gauge | scheduler, every step while prefix caching is enabled (tokens of K/V held by the cross-request prefix cache) |
 | `apex_serving_spec_drafted_total` | counter | `serving_spec_verify` events (draft tokens proposed by prompt lookup) |
 | `apex_serving_spec_accepted_total` | counter | `serving_spec_verify` events (drafted tokens the verify argmax accepted) |
 | `apex_serving_spec_rejected_total` | counter | `serving_spec_verify` events (drafted − accepted; rolled back, never emitted) |
 | `apex_serving_spec_accepted_tokens` | histogram | `serving_spec_verify` events (accepted draft length per verify; token-count buckets) |
-| `apex_serving_spec_speedup` | gauge | scheduler, per step once a verify has run (tokens emitted per verify dispatch; 1.0 == plain decode) |
-| `apex_serving_block_pool_utilization` | gauge | scheduler, every step while a paged engine serves (allocated KV pool blocks / allocatable blocks) |
+| `apex_serving_spec_speedup{replica}` | gauge | scheduler, per step once a verify has run (tokens emitted per verify dispatch; 1.0 == plain decode) |
+| `apex_serving_block_pool_utilization{replica}` | gauge | scheduler, every step while a paged engine serves (allocated KV pool blocks / allocatable blocks) |
 | `apex_serving_block_alias_hits_total` | counter | `serving_block_alias` events (prefix-cache blocks reused by table aliasing — zero-copy hits) |
 | `apex_serving_block_cow_total` | counter | `serving_block_cow` events (copy-on-write block copies — a write hit a shared block) |
-| `apex_serving_preempted_total` | counter | `serving_request_preempted` events (DECODE streams losslessly evicted by a higher-priority admission; each resumes bit-exactly) |
-| `apex_serving_cancelled_total` | counter | `serving_request_cancelled` events (caller-cancelled requests; slot/blocks/pins released) |
-| `apex_serving_shed_total` | counter | `serving_request_shed` events (expired-deadline evictions before further prefill spend; charged against goodput) |
+| `apex_serving_preempted_total{replica}` | counter | `serving_request_preempted` events (DECODE streams losslessly evicted by a higher-priority admission; each resumes bit-exactly) |
+| `apex_serving_cancelled_total{replica}` | counter | `serving_request_cancelled` events (caller-cancelled requests; slot/blocks/pins released) |
+| `apex_serving_shed_total{replica}` | counter | `serving_request_shed` events (expired-deadline evictions before further prefill spend; charged against goodput) |
 | `apex_serving_tenant_inflight{tenant}` | gauge | scheduler, every step while a scheduling policy is enabled (active streams per tenant) |
 | `apex_serving_tp_size` | gauge | `serving_tp_step` events (tensor-parallel mesh width the decode programs run over; 1 == single-chip) |
 | `apex_serving_collective_seconds` | histogram | `serving_tp_step` events (tp decode step wall time, dispatch → completion — an upper bound on per-step collective cost) |
@@ -1333,7 +1339,36 @@ two rounds of a benchmark — aggregate bucket-to-bucket.
 | `apex_serving_quant_bytes_per_token` | gauge | `serving_quant_eval` events — KV bytes pinned per cached token under the active quant config (int8 payload + fp32 scales; the streams-per-GB denominator) |
 | `apex_serving_quant_logit_error` | histogram | `serving_quant_eval` events — max \\|fp32 − quantized\\| logit distance per evaluation window (dimensionless) |
 | `apex_serving_quant_agreement_ratio` | gauge | `serving_quant_eval` events — greedy token-stream agreement vs the fp32 reference over the latest window (1.0 == identical stream) |
+| `apex_serving_alerts_firing{rule}` | gauge | `serving_alert_{firing,resolved}` events — 1 while the named alert rule is firing, 0 after it resolves |
+| `apex_serving_alert_transitions_total` | counter | `serving_alert_{firing,resolved}` events — alert lifecycle edges (each firing and each resolution counts once) |
 | `apex_timer_seconds{region}` | gauge | `Timers.publish_metrics()` |
+
+## Label cardinality
+
+Every label in use, with the vocabulary that bounds it.  Ordinary
+labels are part of a metric's `labelnames` and appear on every series;
+**scope labels** (`replica` today) are declared via
+`scope_labels=` + `MetricsRegistry.declare_scope(label, bound)` and
+attach only to series that opt in — the unlabeled series keeps
+rendering byte-identically, and the registry rejects a value that
+would push the label past its declared bound.
+
+| Label | Bound |
+|---|---|
+| `event` | `emit_event` kind vocabulary — string literals only, linted by `tools/check_events.py` |
+| `what` | retryable-operation names — one per `retrying(what=...)` call site |
+| `failure` | supervisor failure-classification enum |
+| `fault` | fault-injection plan vocabulary (`tests/`/bench chaos plans) |
+| `op` | checkpoint phase enum: `save`/`validate`/`restore`/`snapshot`/`write` |
+| `bucket` | engine prefill bucket table (compile-guard-bounded shape set) |
+| `tenant` | scheduling-policy tenant ids — bounded by the policy's configured tenant set |
+| `phase` | hot-reload phase enum: `restore`/`validate`/`swap` |
+| `state` | fleet health-state enum: `healthy`/`suspect`/`draining`/`dead` |
+| `mode` | failover mode enum: `capture-resume`/`requeue` |
+| `verdict` | canary gate enum: `pass`/`fail` |
+| `rule` | alert-rule names — unique per `AlertEngine`, bounded by the configured rule list |
+| `replica` | scope label — scheduler `name=` values, bound declared as the fleet size (`declare_scope("replica", n)`; widen-only) |
+| `region` | named timer regions — one per `Timers` call site |
 
 ## Exposition formats
 
@@ -1404,6 +1439,75 @@ Chrome trace with **one named track per request** (phases and
 chunk/verify slices nested by containment), `export_jsonl(path)`
 writes one JSON record per request for offline analysis, both through
 the shared atomic-write + non-finite-sanitizing machinery.
+
+## Fleet observability
+
+Three opt-ins turn the single-replica story into a fleet one; all
+three are default-off, and with all three off the event stream and
+metric snapshot are **byte-identical** to an uninstrumented run.
+
+**Per-replica metric attribution.**  Give a scheduler a name
+(`ContinuousBatchingScheduler(..., name="r0")`) and every serving
+event it emits carries `replica="r0"`; the bridge then dual-writes
+each measurement — the unlabeled fleet-aggregate series exactly as
+before, plus a `{replica="r0"}` series for every instrument marked
+`{replica}` in the inventory.  The label is a *scope label*:
+cardinality is bounded by `declare_scope("replica", fleet_size)`
+(the `FleetRouter` declares it at construction; `register_replica`
+widens it as names appear), and an unnamed scheduler produces zero
+labeled series.  Because the labeled series are written from the same
+events as the aggregates, the per-replica sums reconcile **exactly**:
+summing `apex_serving_preempted_total{replica=...}` over replicas
+equals the unlabeled counter, and each replica's histogram counts
+match its `replica_reports()` sample counts.
+
+**Cross-replica hop trails.**  With a `RequestTraceRecorder`
+installed, the fleet router's `serving_fleet_{routed,failover,
+resumed,shed}` events append to each record's `hops` list — a
+placement trail with the schema:
+
+    {"kind": "placed",   "replica": str, "retries": int,
+     "weights_step": int|None, "t": float}
+    {"kind": "failover", "replica": str (the donor), "mode":
+     "capture-resume"|"requeue", "new_tokens": int, "t": float}
+    {"kind": "resumed",  "replica": str (the survivor),
+     "from_replica": str, "mode": str, "duration_s": float, "t": float}
+    {"kind": "shed",     "reason": str, "t": float}
+
+`record.replica` always names the replica currently holding the
+stream.  `to_chrome_trace()` grows **one lane per replica** (tids from
+`REPLICA_TID_BASE`, sorted by name) showing each request's residency
+span on the replica that held it, plus health-state instants, reload
+swap-pause slices, and a fleet control lane carrying rollout
+started/verdict/promoted/halted/rolled-back marks — a `KillReplica`
+chaos drain exports a single Perfetto timeline showing the victim's
+streams migrating to survivors.  Fleet control events are bounded
+separately (`max_fleet_events`, drops counted in `otherData`), and a
+recorder with no fleet content exports byte-identically to before.
+
+**Deterministic alerts (`obs.alerts`).**  `AlertEngine(rules)` is
+handed to the router (`FleetRouter(..., alerts=engine)`) and
+evaluates every rule against a registry snapshot at each fleet step
+boundary **on the fleet's own clock** — no scrape thread, no wall
+time.  Three rule types share one evaluation core (`Condition`, the
+same comparator object `CanaryGate` gates rollouts with):
+`ThresholdRule` (compare a series value — histograms select their
+cumulative count at a bucket edge via `le=`), `AbsenceRule` (a series
+absent or unchanged for `stale_after_s`), and `BurnRateRule`
+(multi-window SLO burn: `bad_fraction / (1 − objective)` computed
+over a long and a short window of snapshot deltas, firing only when
+**both** exceed `factor` — fast to fire on a real burn, fast to
+resolve when it stops).  Rules carry `for_duration_s` hysteresis
+(ok → pending → firing), and each transition appends a ledger entry
+`{step, t, rule, transition, value}` and emits
+`serving_alert_{firing,resolved}` — which the bridge folds into
+`apex_serving_alerts_firing{rule}` /
+`apex_serving_alert_transitions_total`.  The determinism contract:
+rule evaluation touches only the snapshot and the injected clock, so
+the same workload + seed + virtual clock yields a **bit-identical
+ledger** across reruns (tier-1 pins this, firing `replica_down` and
+`goodput_burn` under a scripted chaos drain twice and diffing the
+ledgers).  No engine installed ⇒ no evaluation, no events.
 
 ## SLO reports (`obs.slo`)
 
@@ -2094,6 +2198,53 @@ stream never resumes across versions (it degrades to a deterministic
 same-version replay) — no hybrid streams, ever.  Chaos coverage:
 `CorruptCandidateMidRollout`, `RegressingWeights` (validates clean,
 serves worse — only the gate catches it), `KillCanary`.
+
+Watch a fleet live and page on burn rate — name each replica and its
+serving metrics split per replica (the unlabeled aggregates stay
+byte-identical); install a request recorder and the fleet's failovers
+become hop trails on a per-replica Perfetto timeline; hand the router
+a deterministic alert engine and SLO burn pages at the step boundary,
+on the serving clock, reproducibly
+([full page](api/observability.md)):
+
+```python
+from apex_tpu import obs, serving as sv
+
+replicas = {f"r{i}": sv.ContinuousBatchingScheduler(
+                engines[i], max_queue=64, name=f"r{i}")  # replica label
+            for i in range(3)}
+engine = obs.AlertEngine([
+    # page when a replica dies and stays down
+    obs.ThresholdRule("replica_down",
+                      "apex_serving_fleet_replicas_healthy",
+                      "<", 3, for_duration_s=0.5),
+    # page when TTFT > 250 ms burns the 99% objective at 14.4x —
+    # long window confirms, short window de-flaps the resolution
+    obs.BurnRateRule("goodput_burn",
+                     good=obs.Selector("apex_serving_ttft_seconds",
+                                       le=0.25),
+                     total=obs.Selector("apex_serving_ttft_seconds"),
+                     objective=0.99, long_window_s=30.0,
+                     short_window_s=5.0, factor=14.4),
+], clock=clock.monotonic)
+router = sv.FleetRouter(replicas, alerts=engine)
+
+with obs.recording_requests(clock=clock.monotonic) as rec:
+    out = sv.LoadGenerator(router, wl).run()
+
+print(obs.prometheus_text())     # ...{replica="r1"} series + alerts
+rec.export("/tmp/fleet.trace.json")   # per-replica lanes in Perfetto
+for entry in engine.ledger:      # {step, t, rule, transition, value}
+    print(entry)                 # bit-identical across reruns
+```
+
+Per-replica sums reconcile exactly against the aggregates (same
+events, dual-written), a killed replica's streams render as residency
+spans migrating to the survivor lane, and the firing→resolved ledger
+is pinned bit-identical across reruns in tier-1.  `bench.py`'s
+`obs_fleet` block keeps the whole layer honest: instrumented-vs-bare
+chaos-drain overhead ≤ 1.10×, alert evaluation µs/step at 32 rules,
+and trace-export wall.
 
 End-to-end runnable versions: `examples/simple/main.py` (amp + FusedAdam),
 `examples/imagenet/main.py` (DDP + SyncBatchNorm + checkpointing),
